@@ -1,0 +1,24 @@
+"""ct-mapreduce-tpu: a TPU-native map/reduce framework over Certificate
+Transparency logs.
+
+Rebuilds the capabilities of the reference Go toolkit (jcjones/ct-mapreduce)
+on JAX/XLA/Pallas/pjit: the per-entry hot loop (x509 field extraction,
+SHA-256 identity fingerprinting, known-certificate dedup, per-issuer
+aggregation) runs as batched device compute over HBM-resident entry
+batches, sharded over a `jax.sharding.Mesh` for pod-scale reduce.
+
+Layout:
+  core/        identity & value types, DER parsing, batch schema
+  ops/         device ops (SHA-256, DER field extraction, hash-set, histograms)
+  agg/         on-device aggregate (reduce) state + drain
+  models/      the end-to-end jitted pipeline ("flagship model")
+  parallel/    mesh construction, shardings, multi-host init
+  storage/     pluggable backends + CertDatabase facade (reference parity)
+  ingest/      CT log HTTP client, entry decode, batching, checkpointing
+  coordinator/ multi-process leader election / start barrier
+  config/      layered ini < env < flags configuration
+  telemetry/   metrics registry, dumper, StatsD sink, health endpoint
+  cmd/         CLI entry points (ct-fetch, storage-statistics, ct-getcert)
+"""
+
+__version__ = "0.1.0"

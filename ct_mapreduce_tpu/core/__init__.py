@@ -1,0 +1,13 @@
+"""Identity and value types, DER parsing, and the packed batch schema."""
+
+from ct_mapreduce_tpu.core.types import (  # noqa: F401
+    CertificateLog,
+    ExpDate,
+    Issuer,
+    IssuerAndDate,
+    IssuerDate,
+    Serial,
+    SPKI,
+    UniqueCertIdentifier,
+    certificate_log_id_from_short_url,
+)

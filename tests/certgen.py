@@ -1,0 +1,99 @@
+"""Test-certificate factory.
+
+Mirrors the reference's on-the-fly fixture generation (`makeCert`,
+/root/reference/storage/issuermetadata_test.go:62-98): self-signed CA
+certs with chosen DN / expiry / serial / CRL distribution points, built
+with the `cryptography` package.
+"""
+
+from __future__ import annotations
+
+import datetime
+from functools import lru_cache
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+
+@lru_cache(maxsize=8)
+def _key(seed: int = 0):
+    # Key generation dominates fixture cost; cache a few keys.
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def make_cert(
+    serial: int | None = None,
+    issuer_cn: str = "Test Issuer CA",
+    subject_cn: str | None = None,
+    org: str = "Unit Test Corp",
+    country: str = "US",
+    not_before: datetime.datetime | None = None,
+    not_after: datetime.datetime | None = None,
+    crl_dps: tuple[str, ...] = (),
+    is_ca: bool = True,
+    add_basic_constraints: bool = True,
+    key_seed: int = 0,
+) -> bytes:
+    """Build a self-signed certificate, returning DER bytes."""
+    now = datetime.datetime(2024, 1, 1, tzinfo=datetime.timezone.utc)
+    not_before = not_before or now
+    not_after = not_after or now + datetime.timedelta(days=365)
+    key = _key(key_seed)
+
+    name_attrs = [
+        x509.NameAttribute(NameOID.COUNTRY_NAME, country),
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+        x509.NameAttribute(NameOID.COMMON_NAME, issuer_cn),
+    ]
+    issuer_name = x509.Name(name_attrs)
+    subject_name = (
+        x509.Name(
+            [
+                x509.NameAttribute(NameOID.COUNTRY_NAME, country),
+                x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+                x509.NameAttribute(NameOID.COMMON_NAME, subject_cn),
+            ]
+        )
+        if subject_cn
+        else issuer_name
+    )
+
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(subject_name)
+        .issuer_name(issuer_name)
+        .public_key(key.public_key())
+        .serial_number(serial if serial is not None else x509.random_serial_number())
+        .not_valid_before(not_before)
+        .not_valid_after(not_after)
+    )
+    if add_basic_constraints:
+        builder = builder.add_extension(
+            x509.BasicConstraints(ca=is_ca, path_length=None), critical=True
+        )
+    if crl_dps:
+        builder = builder.add_extension(
+            x509.CRLDistributionPoints(
+                [
+                    x509.DistributionPoint(
+                        full_name=[x509.UniformResourceIdentifier(u)],
+                        relative_name=None,
+                        reasons=None,
+                        crl_issuer=None,
+                    )
+                    for u in crl_dps
+                ]
+            ),
+            critical=False,
+        )
+    cert = builder.sign(key, hashes.SHA256())
+    return cert.public_bytes(serialization.Encoding.DER)
+
+
+def spki_of(der: bytes) -> bytes:
+    cert = x509.load_der_x509_certificate(der)
+    return cert.public_key().public_bytes(
+        serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
+    )

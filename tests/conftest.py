@@ -1,0 +1,28 @@
+"""Test configuration: force an 8-device virtual CPU platform so
+multi-chip sharding paths are exercised without TPU hardware (mirrors
+the reference's pattern of gating real-Redis tests behind env vars,
+/root/reference/storage/rediscache_test.go:16-28 — here the real-TPU
+tests are the gated tier and the virtual mesh is the default)."""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+def on_tpu() -> bool:
+    import jax
+
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+requires_tpu = pytest.mark.skipif(
+    os.environ.get("CT_TPU_TESTS", "") == "", reason="set CT_TPU_TESTS=1 to run"
+)

@@ -1,0 +1,143 @@
+"""DER/X.509 reference-lane tests: validate the pure-Python extractor
+against the `cryptography` package on generated fixtures (the same
+fields the device kernel must later reproduce)."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+from cryptography import x509 as cx509
+
+from ct_mapreduce_tpu.core import der as derlib
+
+from certgen import make_cert, spki_of
+
+
+def test_parse_cert_basic_fields():
+    not_after = datetime(2031, 5, 6, 7, 0, 0, tzinfo=timezone.utc)
+    der = make_cert(
+        serial=0x1122334455,
+        issuer_cn="Acme Root CA",
+        org="Acme Corp",
+        not_after=not_after,
+        crl_dps=("http://crl.acme.example/root.crl",),
+    )
+    fields = derlib.parse_cert(der)
+    assert fields.serial == bytes.fromhex("1122334455")
+    assert fields.not_after == not_after
+    assert fields.issuer_cn == "Acme Root CA"
+    assert fields.is_ca and fields.basic_constraints_valid
+    assert fields.crl_distribution_points == ["http://crl.acme.example/root.crl"]
+    assert fields.spki == spki_of(der)
+    assert fields.not_after_unix_hour == int(not_after.timestamp()) // 3600
+
+
+def test_parse_cert_matches_cryptography():
+    der = make_cert(serial=0x00ABCDEF7788)
+    ours = derlib.parse_cert(der)
+    ref = cx509.load_der_x509_certificate(der)
+    assert ours.serial == ref.serial_number.to_bytes(
+        (ref.serial_number.bit_length() + 8) // 8 or 1, "big"
+    )
+    assert ours.not_before == ref.not_valid_before_utc
+    assert ours.not_after == ref.not_valid_after_utc
+    assert ours.issuer_dn == ref.issuer.rfc4514_string()
+
+
+def test_leading_zero_serial_raw_bytes():
+    der = make_cert(serial=0xF0000001)  # high bit → DER pads with 0x00
+    assert derlib.raw_serial_bytes(der) == bytes([0x00, 0xF0, 0x00, 0x00, 0x01])
+
+
+def test_non_ca_cert():
+    der = make_cert(is_ca=False, subject_cn="leaf.example.com")
+    fields = derlib.parse_cert(der)
+    assert fields.basic_constraints_valid and not fields.is_ca
+    assert "leaf.example.com" in fields.subject_dn
+
+
+def test_no_basic_constraints():
+    der = make_cert(add_basic_constraints=False)
+    fields = derlib.parse_cert(der)
+    assert not fields.basic_constraints_valid and not fields.is_ca
+
+
+def test_multiple_crl_dps():
+    urls = ("http://a.example/c.crl", "https://b.example/d.crl")
+    fields = derlib.parse_cert(make_cert(crl_dps=urls))
+    assert fields.crl_distribution_points == list(urls)
+
+
+def test_utctime_vs_generalizedtime():
+    # Pre-2050 → UTCTime, post-2050 → GeneralizedTime per RFC 5280
+    early = make_cert(not_after=datetime(2049, 1, 1, tzinfo=timezone.utc))
+    late = make_cert(not_after=datetime(2051, 1, 1, tzinfo=timezone.utc))
+    assert derlib.parse_cert(early).not_after.year == 2049
+    assert derlib.parse_cert(late).not_after.year == 2051
+
+
+def test_structural_offsets_are_consistent():
+    der = make_cert(serial=0x77)
+    f = derlib.parse_cert(der)
+    assert der[f.serial_off : f.serial_off + f.serial_len] == f.serial
+    assert der[f.spki_off : f.spki_off + f.spki_len] == f.spki
+    tag = der[f.not_after_tag_off]
+    assert tag in (derlib.TAG_UTC_TIME, derlib.TAG_GENERALIZED_TIME)
+
+
+def test_pem_roundtrip():
+    der = make_cert()
+    pem = derlib.der_to_pem(der)
+    assert derlib.pem_to_der(pem) == der
+    assert derlib.pem_to_der(der) == der  # DER passthrough
+
+
+def test_truncated_der_raises():
+    der = make_cert()
+    with pytest.raises(derlib.DerError):
+        derlib.parse_cert(der[: len(der) // 2])
+
+
+def test_multivalued_rdn_rendering():
+    # Go pkix.Name.String() joins intra-RDN attributes with '+'
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.x509.oid import NameOID
+    from certgen import _key
+    from datetime import datetime, timezone
+
+    name = x509.Name(
+        [
+            x509.RelativeDistinguishedName(
+                [
+                    x509.NameAttribute(NameOID.ORGANIZATION_NAME, "MultiOrg"),
+                    x509.NameAttribute(NameOID.COMMON_NAME, "MultiCN"),
+                ]
+            ),
+            x509.RelativeDistinguishedName(
+                [x509.NameAttribute(NameOID.COUNTRY_NAME, "US")]
+            ),
+        ]
+    )
+    key = _key(0)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(7)
+        .not_valid_before(datetime(2024, 1, 1, tzinfo=timezone.utc))
+        .not_valid_after(datetime(2030, 1, 1, tzinfo=timezone.utc))
+        .sign(key, hashes.SHA256())
+    )
+    der = cert.public_bytes(serialization.Encoding.DER)
+    ours = derlib.parse_cert(der)
+    assert ours.issuer_dn == cx509.load_der_x509_certificate(der).issuer.rfc4514_string()
+    assert "+" in ours.issuer_dn
+    assert ours.issuer_cn == "MultiCN"
+
+
+def test_dn_value_escaping():
+    der = make_cert(issuer_cn='Weird, CA "quoted"')
+    f = derlib.parse_cert(der)
+    assert '\\,' in f.issuer_dn and '\\"' in f.issuer_dn
+    assert f.issuer_dn == cx509.load_der_x509_certificate(der).issuer.rfc4514_string()

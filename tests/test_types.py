@@ -1,0 +1,193 @@
+"""Tier-1 value-type tests, porting the reference's semantics suite
+(/root/reference/storage/types_test.go): lazy issuer IDs, serial
+leading-zero preservation, JSON round-trips, expiry-bucket boundaries,
+and composite-ID parsing."""
+
+import base64
+import hashlib
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from ct_mapreduce_tpu.core.types import (
+    CertificateLog,
+    ExpDate,
+    Issuer,
+    IssuerAndDate,
+    Serial,
+    SPKI,
+    UniqueCertIdentifier,
+    certificate_log_id_from_short_url,
+)
+
+from certgen import make_cert, spki_of
+
+
+def test_issuer_id_is_b64url_sha256_of_spki():
+    # types_test.go:41-57 — ID is base64url(SHA-256(SPKI)), computed lazily
+    der = make_cert()
+    spki = spki_of(der)
+    issuer = Issuer.from_spki(spki)
+    expected = base64.urlsafe_b64encode(hashlib.sha256(spki).digest()).decode()
+    assert issuer.id() == expected
+    assert len(issuer.id()) == 44  # 32 bytes → 44 b64 chars with padding
+    # From-string constructor short-circuits the hash
+    assert Issuer.from_string("abc").id() == "abc"
+
+
+def test_issuer_digest_roundtrip():
+    issuer = Issuer.from_spki(b"\x01\x02\x03")
+    assert issuer.digest() == hashlib.sha256(b"\x01\x02\x03").digest()
+    assert Issuer.from_string(issuer.id()).digest() == issuer.digest()
+
+
+def test_issuer_equality_and_json():
+    a = Issuer.from_spki(b"same")
+    b = Issuer.from_spki(b"same")
+    c = Issuer.from_spki(b"different")
+    assert a == b and a != c
+    assert Issuer.from_json(a.to_json()) == a
+
+
+def test_spki_encodings():
+    spki = SPKI(b"\x00\x01\xfe")
+    assert spki.id() == base64.urlsafe_b64encode(b"\x00\x01\xfe").decode()
+    assert str(spki) == "0001fe"
+
+
+def test_serial_preserves_leading_zeros():
+    # types_test.go:81-101 — the defining property of Serial
+    raw = bytes([0x00, 0xAA, 0xBB, 0xCC])
+    s = Serial.from_bytes(raw)
+    assert s.binary_string() == raw
+    assert s.hex_string() == "00aabbcc"
+    assert Serial.from_hex("00aabbcc").binary_string() == raw
+    assert Serial.from_id_string(s.id()).binary_string() == raw
+
+
+def test_serial_from_der_cert_preserves_leading_zero():
+    # A serial with a high bit forces DER to emit a 0x00 pad byte, which
+    # must be preserved (types.go:165-178 re-parses the TBS raw bytes).
+    der = make_cert(serial=0x80FFEE)
+    s = Serial.from_der_cert(der)
+    assert s.binary_string() == bytes([0x00, 0x80, 0xFF, 0xEE])
+    assert s.as_int() == 0x80FFEE
+
+
+def test_serial_json_roundtrip():
+    s = Serial.from_hex("00deadbeef")
+    assert s.to_json() == '"00deadbeef"'
+    assert Serial.from_json(s.to_json()) == s
+    with pytest.raises(ValueError):
+        Serial.from_json("123")  # not a quoted string
+
+
+def test_serial_ordering():
+    sl = [Serial.from_hex(h) for h in ("03", "01", "0102", "00ff")]
+    ordered = sorted(sl)
+    assert [x.hex_string() for x in ordered] == ["00ff", "01", "0102", "03"]
+
+
+def test_expdate_from_time_truncates_to_hour():
+    # types.go:339-346
+    t = datetime(2027, 3, 4, 5, 45, 39, 123456, tzinfo=timezone.utc)
+    e = ExpDate.from_time(t)
+    assert e.id() == "2027-03-04-05"
+    assert e.hour_resolution
+    assert e.expire_time() == datetime(2027, 3, 4, 5, tzinfo=timezone.utc)
+
+
+def test_expdate_parse_day_and_hour():
+    # types.go:348-365 — >10 chars tries hour format first
+    day = ExpDate.parse("2027-03-04")
+    assert not day.hour_resolution
+    assert day.id() == "2027-03-04"
+    hour = ExpDate.parse("2027-03-04-05")
+    assert hour.hour_resolution
+    assert hour.id() == "2027-03-04-05"
+
+
+def test_expdate_is_expired_at_boundaries():
+    # types_test.go:203-252 — lastGood = bucket end minus 1ms
+    day = ExpDate.parse("2027-03-04")
+    assert not day.is_expired_at(datetime(2027, 3, 4, 23, 59, 59, tzinfo=timezone.utc))
+    assert day.is_expired_at(datetime(2027, 3, 5, 0, 0, 0, tzinfo=timezone.utc))
+    hour = ExpDate.parse("2027-03-04-05")
+    assert not hour.is_expired_at(datetime(2027, 3, 4, 5, 59, 59, tzinfo=timezone.utc))
+    assert hour.is_expired_at(datetime(2027, 3, 4, 6, 0, 0, tzinfo=timezone.utc))
+
+
+def test_expdate_unix_hour_roundtrip():
+    e = ExpDate.from_time(datetime(2030, 6, 15, 7, 30, tzinfo=timezone.utc))
+    assert ExpDate.from_unix_hour(e.unix_hour()).id() == e.id()
+
+
+def test_expdate_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        ExpDate.parse("not-a-date")
+
+
+def test_unique_cert_identifier_roundtrip():
+    # types_test.go:254-269
+    uci = UniqueCertIdentifier(
+        exp_date=ExpDate.parse("2030-01-02-03"),
+        issuer=Issuer.from_string("issuerXYZ"),
+        serial=Serial.from_hex("00cafe"),
+    )
+    s = str(uci)
+    assert s == f"2030-01-02-03::issuerXYZ::{Serial.from_hex('00cafe').id()}"
+    parsed = UniqueCertIdentifier.parse(s)
+    assert parsed.exp_date.id() == "2030-01-02-03"
+    assert parsed.issuer.id() == "issuerXYZ"
+    assert parsed.serial.binary_string() == bytes([0x00, 0xCA, 0xFE])
+    with pytest.raises(ValueError):
+        UniqueCertIdentifier.parse("only::two")
+
+
+def test_issuer_and_date_roundtrip():
+    iad = IssuerAndDate(
+        exp_date=ExpDate.parse("2030-01-02"), issuer=Issuer.from_string("iss")
+    )
+    assert str(iad) == "2030-01-02/iss"
+    parsed = IssuerAndDate.parse(str(iad))
+    assert parsed.exp_date.id() == "2030-01-02"
+    assert parsed.issuer.id() == "iss"
+    with pytest.raises(ValueError):
+        IssuerAndDate.parse("a/b/c")
+
+
+def test_certificate_log_id_and_json():
+    # types.go:25-42
+    log = CertificateLog(
+        short_url="ct.example.com/2027",
+        max_entry=1234,
+        last_entry_time=datetime(2026, 7, 1, 2, 3, 4, tzinfo=timezone.utc),
+        last_update_time=datetime(2026, 7, 2, tzinfo=timezone.utc),
+    )
+    assert log.id() == certificate_log_id_from_short_url("ct.example.com/2027")
+    assert log.id() == base64.urlsafe_b64encode(b"ct.example.com/2027").decode()
+    restored = CertificateLog.from_json(log.to_json())
+    assert restored.short_url == log.short_url
+    assert restored.max_entry == 1234
+    assert restored.last_entry_time == log.last_entry_time
+    assert restored.last_update_time == log.last_update_time
+
+
+def test_certificate_log_parses_go_nano_timestamps():
+    # Go writes RFC3339Nano (up to 9 fractional digits)
+    raw = (
+        '{"ShortURL":"ct.example/x","MaxEntry":5,'
+        '"LastEntryTime":"2026-07-29T12:00:00.123456789Z",'
+        '"LastUpdateTime":"2026-07-29T12:00:01Z"}'
+    )
+    log = CertificateLog.from_json(raw)
+    assert log.last_entry_time is not None
+    assert log.last_entry_time.microsecond == 123456
+    assert log.last_update_time is not None
+
+
+def test_certificate_log_naive_datetime_is_utc():
+    log = CertificateLog(short_url="x", last_entry_time=datetime(2026, 1, 1, 12))
+    assert '"LastEntryTime": "2026-01-01T12:00:00Z"' in log.to_json().replace(
+        '","', '", "'
+    ) or "2026-01-01T12:00:00Z" in log.to_json()
