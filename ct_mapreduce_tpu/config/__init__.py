@@ -1,0 +1,1 @@
+from ct_mapreduce_tpu.config.config import CTConfig  # noqa: F401

@@ -1,0 +1,223 @@
+"""Layered configuration: defaults < ini file < env vars < CLI flags.
+
+Directive names, defaults, and precedence mirror the reference
+(/root/reference/config/config.go:149-214): the ini section is
+consulted first, an environment variable keyed by the directive name
+overrides it, and a handful of CLI flags (-config, -offset, -limit,
+-outputRefreshPeriod) override everything. The default config file is
+~/.ct-fetch.ini when present (config.go:161-169).
+
+TPU-specific directives are additive: `backend` selects the storage
+execution path (noop | localdisk | redis | tpu — BASELINE.json's
+`--backend=tpu` north star), `batchSize` / `meshShape` / `tableBits`
+size the device pipeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import configparser
+import os
+from dataclasses import dataclass, fields
+from pathlib import Path
+from typing import Optional
+
+
+@dataclass
+class CTConfig:
+    # Reference directives (config.go:184-202)
+    offset: int = 0
+    limit: int = 0
+    log_url_list: str = ""  # "logList"
+    num_threads: int = 1
+    log_expired_entries: bool = False
+    run_forever: bool = False
+    polling_delay_mean: str = "10m"
+    polling_delay_std_dev: int = 10
+    save_period: str = "15m"
+    issuer_cn_filter: str = ""
+    cert_path: str = ""
+    google_project_id: str = ""
+    redis_host: str = ""
+    redis_timeout: str = "5s"
+    output_refresh_period: str = "125ms"
+    stats_refresh_period: str = "10m"
+    statsd_host: str = ""
+    statsd_port: int = 0
+    health_addr: str = ":8080"
+    nobars: bool = False
+    # TPU-native additions
+    backend: str = ""  # "", noop, localdisk, redis, tpu
+    batch_size: int = 65536
+    table_bits: int = 22  # dedup table slots = 2**table_bits per shard
+    mesh_shape: str = ""  # e.g. "data:4,expert:2"; empty = all devices on data
+    device_queue_depth: int = 2
+
+    _DIRECTIVES = {
+        # directive name -> (field, type)
+        "offset": ("offset", int),
+        "limit": ("limit", int),
+        "logList": ("log_url_list", str),
+        "numThreads": ("num_threads", int),
+        "logExpiredEntries": ("log_expired_entries", bool),
+        "runForever": ("run_forever", bool),
+        "pollingDelayMean": ("polling_delay_mean", str),
+        "pollingDelayStdDev": ("polling_delay_std_dev", int),
+        "savePeriod": ("save_period", str),
+        "issuerCNFilter": ("issuer_cn_filter", str),
+        "certPath": ("cert_path", str),
+        "googleProjectId": ("google_project_id", str),
+        "redisHost": ("redis_host", str),
+        "redisTimeout": ("redis_timeout", str),
+        "outputRefreshPeriod": ("output_refresh_period", str),
+        "statsRefreshPeriod": ("stats_refresh_period", str),
+        "statsdHost": ("statsd_host", str),
+        "statsdPort": ("statsd_port", int),
+        "healthAddr": ("health_addr", str),
+        "backend": ("backend", str),
+        "batchSize": ("batch_size", int),
+        "tableBits": ("table_bits", int),
+        "meshShape": ("mesh_shape", str),
+        "deviceQueueDepth": ("device_queue_depth", int),
+    }
+
+    @classmethod
+    def load(
+        cls,
+        argv: Optional[list[str]] = None,
+        env: Optional[dict[str, str]] = None,
+        default_ini: Optional[str] = None,
+    ) -> "CTConfig":
+        """Build a config from CLI argv (default: sys.argv[1:]) with the
+        reference's layering."""
+        env = os.environ if env is None else env
+        parser = cls.arg_parser()
+        args, _ = parser.parse_known_args(argv)
+
+        cfg = cls()
+
+        ini_path = args.config
+        if not ini_path:
+            if default_ini is not None:
+                candidate = default_ini
+            else:
+                candidate = str(Path.home() / ".ct-fetch.ini")
+            if os.path.exists(candidate):
+                ini_path = candidate
+
+        section = None
+        if ini_path and os.path.exists(ini_path):
+            parsed = configparser.ConfigParser()
+            # Reference ini files use a top-level (unnamed) section; feed
+            # configparser a synthetic [DEFAULT] header.
+            with open(ini_path) as fh:
+                content = fh.read()
+            if not content.lstrip().startswith("["):
+                content = "[DEFAULT]\n" + content
+            parsed.read_string(content)
+            section = parsed["DEFAULT"] if "DEFAULT" in parsed else None
+            if section is None and parsed.sections():
+                section = parsed[parsed.sections()[0]]
+
+        def apply(field_name: str, typ, value: str) -> bool:
+            try:
+                if typ is bool:
+                    v = value.strip().lower()
+                    if v in ("1", "t", "true"):
+                        parsed = True
+                    elif v in ("0", "f", "false"):
+                        parsed = False
+                    else:  # Go strconv.ParseBool errors on anything else
+                        return False
+                else:
+                    parsed = typ(value)
+            except (TypeError, ValueError):
+                return False  # unparseable values are ignored (config.go:41-60)
+            setattr(cfg, field_name, parsed)
+            return True
+
+        for directive, (field_name, typ) in cls._DIRECTIVES.items():
+            # Env beats file, but only when it parses — an unparseable
+            # env var falls back to the file value (config.go:41-123).
+            if directive in env and apply(field_name, typ, env[directive]):
+                continue
+            if section is not None and directive in section:
+                apply(field_name, typ, section[directive])
+
+        # CLI flags override everything (config.go:204-213)
+        if args.offset:
+            cfg.offset = args.offset
+        if args.limit:
+            cfg.limit = args.limit
+        if args.outputRefreshPeriod != "125ms":
+            cfg.output_refresh_period = args.outputRefreshPeriod
+        if args.nobars:
+            cfg.nobars = True
+        if getattr(args, "backend", None):
+            cfg.backend = args.backend
+        return cfg
+
+    @staticmethod
+    def arg_parser() -> argparse.ArgumentParser:
+        p = argparse.ArgumentParser(add_help=False)
+        p.add_argument("-config", "--config", default="", help="configuration .ini file")
+        p.add_argument("-offset", "--offset", type=int, default=0, help="offset from the beginning")
+        p.add_argument("-limit", "--limit", type=int, default=0, help="limit processing to this many entries")
+        p.add_argument(
+            "-outputRefreshPeriod",
+            "--outputRefreshPeriod",
+            default="125ms",
+            help="Speed for refreshing progress",
+        )
+        p.add_argument("-nobars", "--nobars", action="store_true", help="disable display of download bars")
+        p.add_argument(
+            "-backend",
+            "--backend",
+            default="",
+            help="storage execution path: noop | localdisk | redis | tpu",
+        )
+        return p
+
+    def usage(self) -> str:
+        """Self-documenting directive listing (config.go:216-244)."""
+        lines = [
+            "Environment variable or config file directives:",
+            "",
+            "Choose at most one backing store:",
+            "certPath = Path under which to store full DER-encoded certificates",
+            "",
+            "The external data cache:",
+            "redisHost = address:port of the Redis instance",
+            "",
+            "Options:",
+            "issuerCNFilter = Prefixes to match for CNs for permitted issuers, comma delimited",
+            "runForever = Run forever, pausing `pollingDelay` between runs",
+            "pollingDelayMean = Wait a mean of this long between polls",
+            "pollingDelayStdDev = Use this standard deviation between polls",
+            "logExpiredEntries = Add expired entries to the database",
+            "numThreads = Use this many threads for normal operations",
+            "savePeriod = Duration between state saves, e.g. 15m",
+            "logList = URLs of the CT Logs, comma delimited",
+            "outputRefreshPeriod = Period between output publications",
+            "statsRefreshPeriod = Period between stats dumps to stderr",
+            "statsdHost = host for StatsD information",
+            "statsdPort = port for StatsD information",
+            "redisTimeout = Timeout for operations from Redis, e.g. 10s",
+            "healthAddr = Address for the /health http endpoint",
+            "",
+            "TPU execution:",
+            "backend = noop | localdisk | redis | tpu",
+            "batchSize = device batch size (entries per dispatch)",
+            "tableBits = log2 of dedup-table slots per shard",
+            "meshShape = device mesh, e.g. data:4,expert:2",
+            "deviceQueueDepth = host->device prefetch depth",
+        ]
+        return "\n".join(lines)
+
+    def log_urls(self) -> list[str]:
+        return [u.strip() for u in self.log_url_list.split(",") if u.strip()]
+
+    def issuer_cn_filters(self) -> list[str]:
+        if not self.issuer_cn_filter:
+            return []
+        return self.issuer_cn_filter.split(",")

@@ -1,0 +1,1 @@
+from ct_mapreduce_tpu.coordinator.coordinator import Coordinator  # noqa: F401

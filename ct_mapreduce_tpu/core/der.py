@@ -145,13 +145,21 @@ def _decode_oid(content: bytes) -> str:
     """Dotted-decimal rendering of an OID's content bytes."""
     if not content:
         return ""
-    parts = [content[0] // 40, content[0] % 40]
+    subids = []
     val = 0
-    for b in content[1:]:
+    for b in content:
         val = (val << 7) | (b & 0x7F)
         if not b & 0x80:
-            parts.append(val)
+            subids.append(val)
             val = 0
+    # First subidentifier encodes arc1*40+arc2 and may itself be
+    # multi-byte (e.g. 2.999 → 1079 → 0x88 0x37).
+    first = subids[0]
+    if first < 80:
+        parts = [first // 40, first % 40]
+    else:
+        parts = [2, first - 80]
+    parts.extend(subids[1:])
     return ".".join(str(p) for p in parts)
 
 
@@ -202,11 +210,10 @@ def parse_name(buf: bytes, off: int) -> tuple[list[list[NameAttribute]], int]:
     return rdns, end
 
 
-def render_dn(rdns: list[list[NameAttribute]]) -> str:
-    """Render a DN the way Go's pkix.Name.String() does: RDNs in reverse
-    encoded order joined by ',', attributes within a multi-valued RDN in
-    encoded order joined by '+', RFC 2253 escaping, OID abbreviations
-    (unknown types rendered as dotted decimal)."""
+def render_dn_rfc4514(rdns: list[list[NameAttribute]]) -> str:
+    """Structure-preserving RFC 4514 rendering: RDNs in reverse encoded
+    order joined by ',', attributes within a multi-valued RDN joined by
+    '+' (matches cryptography's rfc4514_string for known types)."""
     parts = []
     for rdn in reversed(rdns):
         parts.append(
@@ -215,6 +222,55 @@ def render_dn(rdns: list[list[NameAttribute]]) -> str:
                 f"={_escape_dn_value(a.value)}"
                 for a in rdn
             )
+        )
+    return ",".join(parts)
+
+
+# pkix.Name.ToRDNSequence appends attribute groups in this fixed order
+# (certificate-transparency-go x509/pkix, Go 1.13-era fork); String()
+# then renders the sequence reversed.
+_GO_CANONICAL_ORDER = [
+    bytes([0x55, 0x04, 0x06]),  # C
+    bytes([0x55, 0x04, 0x08]),  # ST
+    bytes([0x55, 0x04, 0x07]),  # L
+    bytes([0x55, 0x04, 0x09]),  # STREET
+    bytes([0x55, 0x04, 0x11]),  # POSTALCODE
+    bytes([0x55, 0x04, 0x0A]),  # O
+    bytes([0x55, 0x04, 0x0B]),  # OU
+    bytes([0x55, 0x04, 0x03]),  # CN (single-valued, last occurrence wins)
+    bytes([0x55, 0x04, 0x05]),  # SERIALNUMBER (single-valued, last wins)
+]
+_GO_SINGLE_VALUED = {bytes([0x55, 0x04, 0x03]), bytes([0x55, 0x04, 0x05])}
+
+
+def render_dn(rdns: list[list[NameAttribute]]) -> str:
+    """Render a DN the way the reference observes it: Go
+    pkix.Name.String() == FillFromRDNSequence → ToRDNSequence → String.
+
+    Go *canonicalizes*: attributes are regrouped by type into the fixed
+    order C, ST, L, STREET, POSTALCODE, O, OU, CN, SERIALNUMBER (one RDN
+    per type, multi-valued types '+'-joined), the sequence is rendered
+    reversed, CN/SERIALNUMBER keep only the last occurrence, and
+    attribute types outside that set are dropped. The reference stores
+    aCert.Issuer.String() into the issuer::<id> set
+    (/root/reference/storage/issuermetadata.go:92-94), so cache parity
+    requires reproducing this exactly rather than RFC 4514 structure
+    preservation (see render_dn_rfc4514 for that)."""
+    by_type: dict[bytes, list[str]] = {}
+    for rdn in rdns:
+        for attr in rdn:
+            if attr.oid in _GO_SINGLE_VALUED:
+                by_type[attr.oid] = [attr.value]  # last occurrence wins
+            elif attr.oid in _DN_ABBREVIATIONS:
+                by_type.setdefault(attr.oid, []).append(attr.value)
+    parts = []
+    for oid in reversed(_GO_CANONICAL_ORDER):
+        values = by_type.get(oid)
+        if not values:
+            continue
+        abbrev = _DN_ABBREVIATIONS[oid]
+        parts.append(
+            "+".join(f"{abbrev}={_escape_dn_value(v)}" for v in values)
         )
     return ",".join(parts)
 
@@ -435,8 +491,10 @@ def pem_to_der(pem: bytes | str) -> bytes:
     """Decode the first PEM CERTIFICATE block (or pass DER through)."""
     if isinstance(pem, str):
         pem = pem.encode("ascii")
-    if not pem.lstrip().startswith(b"-----"):
+    # Accept files with leading text (e.g. `openssl x509 -text` output)
+    if b"-----BEGIN" not in pem:
         return bytes(pem)
+    pem = pem[pem.index(b"-----BEGIN") :]
     lines = []
     inside = False
     for line in pem.splitlines():

@@ -1,0 +1,16 @@
+"""Storage layer: the CertDatabase facade, domain aggregates, pluggable
+durable backends, and the remote-cache fabric (reference parity with
+/root/reference/storage/)."""
+
+from ct_mapreduce_tpu.storage.interfaces import (  # noqa: F401
+    CertDatabase,
+    RemoteCache,
+    StorageBackend,
+)
+from ct_mapreduce_tpu.storage.mockcache import MockRemoteCache  # noqa: F401
+from ct_mapreduce_tpu.storage.knowncerts import KnownCertificates  # noqa: F401
+from ct_mapreduce_tpu.storage.issuermetadata import IssuerMetadata  # noqa: F401
+from ct_mapreduce_tpu.storage.noop import NoopBackend  # noqa: F401
+from ct_mapreduce_tpu.storage.localdisk import LocalDiskBackend  # noqa: F401
+from ct_mapreduce_tpu.storage.mockbackend import MockBackend  # noqa: F401
+from ct_mapreduce_tpu.storage.certdb import FilesystemDatabase  # noqa: F401

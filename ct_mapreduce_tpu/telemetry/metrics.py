@@ -1,0 +1,182 @@
+"""Metrics: a global-sink API in the style the reference emits through
+(armon/go-metrics — counters, gauges, timing samples with dotted key
+paths), with an in-memory sink periodically dumped to stderr and an
+optional StatsD UDP sink.
+
+Reference: /root/reference/telemetry/telemetry.go (MetricsDumper on a
+ticker, :24-87) and /root/reference/engine/engine.go:50-86 (StatsD when
+configured, else in-mem + dumper). Metric names are preserved so
+dashboards keyed on the reference's names keep working; the headline
+gauge for the TPU path is `entries_per_sec_per_chip`.
+"""
+
+from __future__ import annotations
+
+import socket
+import sys
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Optional
+
+
+class InMemSink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: dict[str, float] = defaultdict(float)
+        self.gauges: dict[str, float] = {}
+        self.samples: dict[str, list[float]] = defaultdict(list)
+
+    def incr_counter(self, key: str, value: float) -> None:
+        with self._lock:
+            self.counters[key] += value
+
+    def set_gauge(self, key: str, value: float) -> None:
+        with self._lock:
+            self.gauges[key] = value
+
+    def add_sample(self, key: str, value: float) -> None:
+        with self._lock:
+            samples = self.samples[key]
+            samples.append(value)
+            if len(samples) > 4096:  # bound memory on hot paths
+                del samples[: len(samples) - 4096]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "samples": {
+                    k: {
+                        "count": len(v),
+                        "sum": sum(v),
+                        "min": min(v),
+                        "max": max(v),
+                        "mean": sum(v) / len(v),
+                    }
+                    for k, v in self.samples.items()
+                    if v
+                },
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.samples.clear()
+
+
+class StatsdSink:
+    """Minimal StatsD UDP emitter (engine.go:55-63 equivalent)."""
+
+    def __init__(self, host: str, port: int, prefix: str = ""):
+        self.addr = (host, port)
+        self.prefix = prefix
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def _send(self, payload: str) -> None:
+        try:
+            self._sock.sendto(payload.encode("ascii"), self.addr)
+        except OSError:
+            pass  # metrics must never take down the pipeline
+
+    def incr_counter(self, key: str, value: float) -> None:
+        self._send(f"{self.prefix}{key}:{value}|c")
+
+    def set_gauge(self, key: str, value: float) -> None:
+        self._send(f"{self.prefix}{key}:{value}|g")
+
+    def add_sample(self, key: str, value: float) -> None:
+        self._send(f"{self.prefix}{key}:{value * 1000:.3f}|ms")
+
+
+# -- global sink (go-metrics style) -------------------------------------
+
+_sink: InMemSink | StatsdSink = InMemSink()
+_fanout: list = []
+
+
+def set_sink(sink, *extra) -> None:
+    global _sink, _fanout
+    _sink = sink
+    _fanout = list(extra)
+
+
+def get_sink():
+    return _sink
+
+
+def _key(parts: tuple[str, ...]) -> str:
+    return ".".join(parts)
+
+
+def incr_counter(*parts: str, value: float = 1.0) -> None:
+    _sink.incr_counter(_key(parts), value)
+    for s in _fanout:
+        s.incr_counter(_key(parts), value)
+
+
+def set_gauge(*parts: str, value: float) -> None:
+    _sink.set_gauge(_key(parts), value)
+    for s in _fanout:
+        s.set_gauge(_key(parts), value)
+
+
+def add_sample(*parts: str, value: float) -> None:
+    _sink.add_sample(_key(parts), value)
+    for s in _fanout:
+        s.add_sample(_key(parts), value)
+
+
+@contextmanager
+def measure(*parts: str):
+    """MeasureSince equivalent: time a block into a sample metric."""
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        add_sample(*parts, value=time.perf_counter() - start)
+
+
+class MetricsDumper:
+    """Periodic dump of in-mem metrics to stderr on a background thread
+    (telemetry/telemetry.go:37-87)."""
+
+    def __init__(self, sink: InMemSink, period_s: float, out=None):
+        self.sink = sink
+        self.period_s = period_s
+        self.out = out if out is not None else sys.stderr
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="metrics-dumper", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self.dump()
+
+    def dump(self) -> None:
+        snap = self.sink.snapshot()
+        ts = time.strftime("%Y-%m-%d %H:%M:%S")
+        lines = [f"[{ts}] metrics:"]
+        for k, v in sorted(snap["gauges"].items()):
+            lines.append(f"  [G] {k}: {v}")
+        for k, v in sorted(snap["counters"].items()):
+            lines.append(f"  [C] {k}: {v}")
+        for k, s in sorted(snap["samples"].items()):
+            lines.append(
+                f"  [S] {k}: count={s['count']} mean={s['mean']:.6f}s "
+                f"min={s['min']:.6f}s max={s['max']:.6f}s"
+            )
+        print("\n".join(lines), file=self.out, flush=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)

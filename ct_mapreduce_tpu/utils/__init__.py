@@ -1,0 +1,7 @@
+"""Small shared helpers: Go-style duration strings, jittered backoff."""
+
+from ct_mapreduce_tpu.utils.durations import (  # noqa: F401
+    format_duration,
+    parse_duration,
+)
+from ct_mapreduce_tpu.utils.backoff import JitteredBackoff  # noqa: F401

@@ -1,0 +1,92 @@
+"""Config layering tests (reference: config/config_test.go:8-86 and
+config.go:183-214 precedence: defaults < ini < env < CLI flags)."""
+
+from ct_mapreduce_tpu.config import CTConfig
+
+
+def test_defaults(tmp_path):
+    cfg = CTConfig.load(argv=[], env={}, default_ini=str(tmp_path / "missing.ini"))
+    assert cfg.num_threads == 1
+    assert cfg.save_period == "15m"
+    assert cfg.polling_delay_mean == "10m"
+    assert cfg.polling_delay_std_dev == 10
+    assert cfg.output_refresh_period == "125ms"
+    assert cfg.health_addr == ":8080"
+    assert cfg.redis_timeout == "5s"
+    assert not cfg.run_forever and not cfg.log_expired_entries
+
+
+def test_ini_file_overrides_defaults(tmp_path):
+    ini = tmp_path / "ct.ini"
+    ini.write_text(
+        "numThreads = 7\nlogList = https://a.example/log, https://b.example/log\n"
+        "runForever = true\nissuerCNFilter = Let's Encrypt\n"
+    )
+    cfg = CTConfig.load(argv=["--config", str(ini)], env={})
+    assert cfg.num_threads == 7
+    assert cfg.run_forever is True
+    assert cfg.log_urls() == ["https://a.example/log", "https://b.example/log"]
+    assert cfg.issuer_cn_filters() == ["Let's Encrypt"]
+
+
+def test_env_beats_ini(tmp_path):
+    ini = tmp_path / "ct.ini"
+    ini.write_text("numThreads = 7\ncertPath = /from/ini\n")
+    cfg = CTConfig.load(
+        argv=["--config", str(ini)],
+        env={"numThreads": "3", "certPath": "/from/env"},
+    )
+    assert cfg.num_threads == 3
+    assert cfg.cert_path == "/from/env"
+
+
+def test_cli_flags_beat_everything(tmp_path):
+    ini = tmp_path / "ct.ini"
+    ini.write_text("offset = 5\nlimit = 10\n")
+    cfg = CTConfig.load(
+        argv=["--config", str(ini), "--offset", "100", "--limit", "200"],
+        env={"offset": "50"},
+    )
+    assert cfg.offset == 100
+    assert cfg.limit == 200
+
+
+def test_unparseable_values_keep_defaults(tmp_path):
+    ini = tmp_path / "ct.ini"
+    ini.write_text("numThreads = banana\n")
+    cfg = CTConfig.load(argv=["--config", str(ini)], env={})
+    assert cfg.num_threads == 1
+
+
+def test_tpu_directives(tmp_path):
+    ini = tmp_path / "ct.ini"
+    ini.write_text("backend = tpu\nbatchSize = 131072\ntableBits = 24\n")
+    cfg = CTConfig.load(argv=["--config", str(ini)], env={})
+    assert cfg.backend == "tpu"
+    assert cfg.batch_size == 131072
+    assert cfg.table_bits == 24
+    cfg2 = CTConfig.load(argv=["--config", str(ini), "--backend", "redis"], env={})
+    assert cfg2.backend == "redis"
+
+
+def test_usage_mentions_every_reference_directive():
+    text = CTConfig().usage()
+    for directive in (
+        "certPath",
+        "redisHost",
+        "issuerCNFilter",
+        "runForever",
+        "pollingDelayMean",
+        "pollingDelayStdDev",
+        "logExpiredEntries",
+        "numThreads",
+        "savePeriod",
+        "logList",
+        "outputRefreshPeriod",
+        "statsRefreshPeriod",
+        "statsdHost",
+        "statsdPort",
+        "redisTimeout",
+        "healthAddr",
+    ):
+        assert directive in text, f"usage() missing {directive}"

@@ -1,0 +1,279 @@
+"""Host storage layer tests: mock cache semantics, KnownCertificates
+dedup, IssuerMetadata accumulation, backend conformance, and the
+FilesystemDatabase store flow (reference:
+storage/{mockcache,knowncertificates,issuermetadata,filesystemdatabase}_test.go)."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from ct_mapreduce_tpu.core.types import CertificateLog, ExpDate, Issuer, Serial
+from ct_mapreduce_tpu.storage import (
+    FilesystemDatabase,
+    IssuerMetadata,
+    KnownCertificates,
+    LocalDiskBackend,
+    MockBackend,
+    MockRemoteCache,
+    NoopBackend,
+)
+from ct_mapreduce_tpu.storage.conformance import run_full_conformance
+
+from certgen import make_cert
+
+
+# -- MockRemoteCache ----------------------------------------------------
+
+
+def test_set_semantics():
+    c = MockRemoteCache()
+    assert c.set_insert("k", "a") is True
+    assert c.set_insert("k", "a") is False
+    assert c.set_insert("k", "b") is True
+    assert c.set_contains("k", "a")
+    assert not c.set_contains("k", "z")
+    assert c.set_cardinality("k") == 2
+    assert c.set_list("k") == ["a", "b"]
+    assert c.set_remove("k", "a") is True
+    assert c.set_remove("k", "a") is False
+    assert c.set_cardinality("k") == 1
+
+
+def test_ttl_expiry():
+    c = MockRemoteCache()
+    c.set_insert("gone", "x")
+    c.expire_at("gone", datetime.now(timezone.utc) - timedelta(seconds=1))
+    assert not c.exists("gone")
+    c.set_insert("stays", "x")
+    c.expire_in("stays", timedelta(hours=1))
+    assert c.exists("stays")
+
+
+def test_queue_semantics():
+    c = MockRemoteCache()
+    assert c.queue("q", "one") == 1
+    assert c.queue("q", "two") == 2
+    assert c.queue_length("q") == 2
+    assert c.pop("q") == "one"
+    with pytest.raises(KeyError):
+        c.pop("empty")
+    # BRPOPLPUSH pops the tail into dest's head
+    c.queue("src", "a")
+    c.queue("src", "b")
+    assert c.blocking_pop_copy("src", "dst", timedelta(seconds=1)) == "b"
+    assert c.pop("dst") == "b"
+    with pytest.raises(TimeoutError):
+        c.blocking_pop_copy("empty", "dst", timedelta(milliseconds=20))
+
+
+def test_try_set_is_first_writer_wins():
+    c = MockRemoteCache()
+    assert c.try_set("lock", "alice", timedelta(minutes=5)) == "alice"
+    assert c.try_set("lock", "bob", timedelta(minutes=5)) == "alice"
+
+
+def test_keys_matching():
+    c = MockRemoteCache()
+    c.set_insert("serials::2050-01-01::issA", "x")
+    c.set_insert("serials::2050-01-02::issB", "x")
+    c.set_insert("crl::issA", "x")
+    keys = sorted(c.keys_matching("serials::*"))
+    assert keys == [
+        "serials::2050-01-01::issA",
+        "serials::2050-01-02::issB",
+    ]
+
+
+def test_log_state_roundtrip():
+    c = MockRemoteCache()
+    assert c.load_log_state("nope") is None
+    c.store_log_state(CertificateLog(short_url="l.example/x", max_entry=9))
+    assert c.load_log_state("l.example/x").max_entry == 9
+
+
+# -- KnownCertificates --------------------------------------------------
+
+
+def test_was_unknown_dedups():
+    # knowncertificates_test.go semantics
+    cache = MockRemoteCache()
+    kc = KnownCertificates(ExpDate.parse("2050-01-01"), Issuer.from_string("i"), cache)
+    s1 = Serial.from_hex("00aa")
+    s2 = Serial.from_hex("bb")
+    assert kc.was_unknown(s1) is True
+    assert kc.was_unknown(s1) is False
+    assert kc.was_unknown(s2) is True
+    assert kc.count() == 2
+    known = {s.hex_string() for s in kc.known()}
+    assert known == {"00aa", "bb"}
+
+
+def test_known_dedups_scan_duplicates():
+    # The Duplicate knob simulates Redis SSCAN replay
+    # (mockcache.go:14-24,109-118; knowncertificates.go:65-96)
+    cache = MockRemoteCache(duplicate=2)
+    kc = KnownCertificates(ExpDate.parse("2050-01-01"), Issuer.from_string("i"), cache)
+    kc.was_unknown(Serial.from_hex("01"))
+    kc.was_unknown(Serial.from_hex("02"))
+    assert len(list(cache.set_to_iter(kc.serial_id()))) == 6  # duplicated stream
+    assert len(kc.known()) == 2  # client-side dedup absorbs it
+
+
+def test_serials_key_format():
+    kc = KnownCertificates(
+        ExpDate.parse("2050-01-01-05"), Issuer.from_string("issuerX"), MockRemoteCache()
+    )
+    assert kc.serial_id() == "serials::2050-01-01-05::issuerX"
+
+
+def test_expiry_set_once_to_bucket_expiry():
+    cache = MockRemoteCache()
+    exp = ExpDate.parse("2050-01-01")
+    kc = KnownCertificates(exp, Issuer.from_string("i"), cache)
+    kc.was_unknown(Serial.from_hex("01"))
+    assert cache._expirations[kc.serial_id()] == exp.expire_time()
+
+
+# -- IssuerMetadata -----------------------------------------------------
+
+
+def test_accumulate_metadata():
+    cache = MockRemoteCache()
+    meta = IssuerMetadata(Issuer.from_string("iss"), cache)
+    exp = ExpDate.parse("2050-01-01")
+    seen = meta.accumulate(exp, "CN=Foo CA,O=Foo", ["http://crl.foo/x.crl"])
+    assert seen is False  # first time this bucket
+    seen = meta.accumulate(exp, "CN=Foo CA,O=Foo", ["http://crl.foo/x.crl"])
+    assert seen is True
+    assert meta.issuers() == ["CN=Foo CA,O=Foo"]
+    assert meta.crls() == ["http://crl.foo/x.crl"]
+
+
+def test_crl_scheme_filtering():
+    # issuermetadata.go:48-73: ldap(s) silently dropped, unknown schemes
+    # ignored, http/https kept
+    cache = MockRemoteCache()
+    meta = IssuerMetadata(Issuer.from_string("iss"), cache)
+    meta.accumulate(
+        ExpDate.parse("2050-01-01"),
+        "CN=X",
+        [
+            "http://ok.example/a.crl",
+            "https://ok.example/b.crl",
+            "ldap://dropped.example/x",
+            "ldaps://dropped.example/y",
+            "ftp://ignored.example/z",
+        ],
+    )
+    assert sorted(meta.crls()) == [
+        "http://ok.example/a.crl",
+        "https://ok.example/b.crl",
+    ]
+
+
+def test_metadata_keys():
+    cache = MockRemoteCache()
+    meta = IssuerMetadata(Issuer.from_string("issuerQ"), cache)
+    meta.accumulate(ExpDate.parse("2050-01-01"), "CN=Q", ["http://q/crl"])
+    assert cache.set_list("crl::issuerQ") == ["http://q/crl"]
+    assert cache.set_list("issuer::issuerQ") == ["CN=Q"]
+
+
+# -- backends -----------------------------------------------------------
+
+
+def test_mock_backend_conformance():
+    run_full_conformance(MockBackend())
+
+
+def test_localdisk_backend_conformance(tmp_path):
+    run_full_conformance(LocalDiskBackend(tmp_path / "certs"))
+
+
+def test_localdisk_layout(tmp_path):
+    # localdiskbackend.go:194-199: <root>/<expDate>/<issuerID>/<serialID>
+    root = tmp_path / "certs"
+    b = LocalDiskBackend(root)
+    exp = ExpDate.parse("2050-01-01")
+    issuer = Issuer.from_string("issuerDir")
+    serial = Serial.from_hex("0042")
+    b.store_certificate_pem(serial, exp, issuer, b"PEMDATA")
+    assert (root / "2050-01-01" / "issuerDir" / serial.id()).read_bytes() == b"PEMDATA"
+    b.mark_dirty("2050-01-01")
+    assert (root / "2050-01-01" / ".dirty").exists()
+
+
+def test_noop_backend():
+    # noopbackend.go:16-69: stores succeed, loads fail
+    b = NoopBackend()
+    exp = ExpDate.parse("2050-01-01")
+    issuer = Issuer.from_string("i")
+    b.store_certificate_pem(Serial.from_hex("01"), exp, issuer, b"x")
+    with pytest.raises(NotImplementedError):
+        b.load_certificate_pem(Serial.from_hex("01"), exp, issuer)
+    assert b.list_expiration_dates(datetime(2049, 1, 1)) == []
+
+
+# -- FilesystemDatabase -------------------------------------------------
+
+
+@pytest.fixture
+def db():
+    return FilesystemDatabase(MockBackend(), MockRemoteCache())
+
+
+def test_store_flow(db):
+    # filesystemdatabase_test.go:67-140 analog over generated certs
+    issuer_der = make_cert(issuer_cn="Root CA", key_seed=1)
+    leaf = make_cert(
+        serial=0x1001,
+        issuer_cn="Root CA",
+        subject_cn="site.example",
+        is_ca=False,
+        crl_dps=("http://crl.root/ca.crl",),
+        not_after=datetime(2049, 6, 1, 12, 30, tzinfo=timezone.utc),
+    )
+    db.store(leaf, issuer_der, "log.example/x", 1)
+    db.store(leaf, issuer_der, "log.example/x", 2)  # duplicate
+
+    from ct_mapreduce_tpu.core import der as derlib
+
+    issuer = Issuer.from_spki(derlib.parse_cert(issuer_der).spki)
+    exp = ExpDate.from_time(datetime(2049, 6, 1, 12, 30, tzinfo=timezone.utc))
+    kc = db.get_known_certificates(exp, issuer)
+    assert kc.count() == 1  # dedup worked
+    meta = db.get_issuer_metadata(issuer)
+    assert meta.crls() == ["http://crl.root/ca.crl"]
+    assert "2049-06-01" in db.backend.dirty
+
+    # Backend got the PEM under the right identity
+    serials = db.backend.list_serials_for_expiration_date_and_issuer(exp, issuer)
+    assert [s.as_int() for s in serials] == [0x1001]
+
+
+def test_issuer_and_dates_from_cache(db):
+    issuer_der = make_cert(issuer_cn="Enum CA", key_seed=2)
+    for i, hour in enumerate((1, 2)):
+        leaf = make_cert(
+            serial=0x2000 + i,
+            issuer_cn="Enum CA",
+            is_ca=False,
+            not_after=datetime(2049, 7, 1, hour, tzinfo=timezone.utc),
+        )
+        db.store(leaf, issuer_der, "log.example/x", i)
+    result = db.get_issuer_and_dates_from_cache()
+    assert len(result) == 1
+    assert len(result[0].exp_dates) == 2
+    assert [e.id() for e in result[0].exp_dates] == ["2049-07-01-01", "2049-07-01-02"]
+
+
+def test_log_state_dual_write(db):
+    # filesystemdatabase.go:110-139: dual write, cache-first read
+    log = CertificateLog(short_url="log.example/y", max_entry=7)
+    db.save_log_state(log)
+    assert db.ext_cache.load_log_state("log.example/y").max_entry == 7
+    assert db.backend.load_log_state("log.example/y").max_entry == 7
+    assert db.get_log_state("log.example/y").max_entry == 7
+    # Unknown log yields a fresh zero-state record
+    fresh = db.get_log_state("never.seen/log")
+    assert fresh.max_entry == 0 and fresh.short_url == "never.seen/log"
