@@ -1,0 +1,209 @@
+"""Device-resident dedup set: open-addressed hash table in HBM.
+
+This is the TPU-native replacement for the reference's per-certificate
+Redis ``SADD`` round trip (`WasUnknown`,
+/root/reference/storage/knowncertificates.go:38-55 →
+/root/reference/storage/rediscache.go:57-65): a whole batch of
+certificate fingerprints is inserted in one jitted op, returning the
+per-lane "was unknown" bit with the same semantics Redis set-insert
+gives (first writer wins; re-inserting a known key is a no-op).
+
+Keys are 128-bit truncated SHA-256 fingerprints of
+``(expHour, issuerDigest, serial)`` — see
+:func:`ct_mapreduce_tpu.core.packing.fingerprint_block` — stored as
+``uint32[capacity, 4]``. The all-zero key is the empty sentinel; real
+fingerprints are remapped away from it (probability 2^-128 anyway).
+
+Insertion algorithm (all fixed trip-count, jit/pjit-friendly):
+
+1. *Within-batch dedup*: lexsort lanes by the 4 key words; a lane is a
+   "representative" iff its key differs from its sorted predecessor.
+   Duplicate lanes inside one batch report ``was_unknown=False`` for
+   every occurrence after the first, matching Redis semantics when the
+   reference stores the same serial twice in a row.
+2. *Probe rounds* (triangular probing over a power-of-two capacity,
+   guaranteed full-cycle): each pending representative gathers its
+   slot; a 4-word compare detects "already present"; empty slots are
+   claimed by electing exactly one winner per slot via a sort over
+   ``(slot, lane)`` — winners scatter with **unique** indices, so the
+   update is deterministic (no reliance on XLA duplicate-scatter
+   ordering).
+3. Lanes that exhaust ``max_probes`` are reported in ``overflowed``;
+   the aggregator sends them down the exact host lane (the same
+   reject-to-host contract the reference uses for unparseable entries,
+   /root/reference/cmd/ct-fetch/ct-fetch.go:206-225).
+
+Alongside each key a ``meta`` word (packed issuer index + expiry hour
+offset, :mod:`ct_mapreduce_tpu.core.packing`) is stored so a drain can
+reconstruct exact per-(issuer, expDate) serial counts without a second
+device pass.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TableState(NamedTuple):
+    """Dedup-set state living in HBM (donated through insert steps)."""
+
+    keys: jax.Array  # uint32[capacity, 4]; all-zero row = empty
+    meta: jax.Array  # uint32[capacity]; packed (issuer_idx, exp_hour_offset)
+    count: jax.Array  # int32[]; occupied slots
+
+
+def make_table(capacity: int) -> TableState:
+    if capacity & (capacity - 1):
+        raise ValueError(f"capacity must be a power of two, got {capacity}")
+    return TableState(
+        keys=jnp.zeros((capacity, 4), dtype=jnp.uint32),
+        meta=jnp.zeros((capacity,), dtype=jnp.uint32),
+        count=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def _home_slot(keys: jax.Array, capacity: int) -> jax.Array:
+    """Initial probe slot from the fingerprint's first two words."""
+    h = keys[:, 0] ^ (keys[:, 1] * np.uint32(0x9E3779B9))
+    return (h & np.uint32(capacity - 1)).astype(jnp.int32)
+
+
+def _desentinel(keys: jax.Array) -> jax.Array:
+    """Remap the (astronomically unlikely) all-zero fingerprint."""
+    is_zero = jnp.all(keys == 0, axis=-1, keepdims=True)
+    bump = jnp.concatenate(
+        [jnp.zeros(keys.shape[:-1] + (3,), jnp.uint32),
+         jnp.ones(keys.shape[:-1] + (1,), jnp.uint32)], axis=-1)
+    return jnp.where(is_zero, bump, keys)
+
+
+@functools.partial(jax.jit, static_argnames=("max_probes",), donate_argnums=(0,))
+def insert(
+    state: TableState,
+    keys: jax.Array,
+    meta: jax.Array,
+    valid: jax.Array,
+    max_probes: int = 32,
+):
+    """Batch insert-if-absent.
+
+    Args:
+      state: the table (donated; updated in place in HBM).
+      keys: uint32[B, 4] fingerprints.
+      meta: uint32[B] per-lane metadata scattered on successful insert.
+      valid: bool[B]; padding lanes are ignored entirely.
+      max_probes: probe rounds before declaring overflow.
+
+    Returns:
+      (new_state, was_unknown bool[B], overflowed bool[B]).
+    """
+    capacity = state.keys.shape[0]
+    b = keys.shape[0]
+    keys = _desentinel(keys.astype(jnp.uint32))
+
+    # --- 1. within-batch first-occurrence detection ---------------------
+    # lexsort: last key is primary. Invalid lanes sort with key 0 but are
+    # masked out of representative status below.
+    order = jnp.lexsort((keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0]))
+    sk = keys[order]
+    same_as_prev = jnp.concatenate(
+        [jnp.zeros((1,), bool), jnp.all(sk[1:] == sk[:-1], axis=-1)]
+    )
+    sorted_valid = valid[order]
+    # First *valid* lane of each equal-key run is the representative.
+    # (Invalid lanes never represent; a run of [invalid, valid] with equal
+    # keys must still elect the valid one, so walk with a scan max.)
+    run_id = jnp.cumsum(~same_as_prev)  # 1-based run index per sorted lane
+    # representative = first valid lane in its run
+    first_valid_pos = jnp.full((b + 1,), b, dtype=jnp.int32)
+    pos = jnp.arange(b, dtype=jnp.int32)
+    first_valid_pos = first_valid_pos.at[run_id].min(
+        jnp.where(sorted_valid, pos, b)
+    )
+    sorted_rep = sorted_valid & (pos == first_valid_pos[run_id])
+    rep = jnp.zeros((b,), bool).at[order].set(sorted_rep)
+
+    # --- 2. probe rounds ------------------------------------------------
+    home = _home_slot(keys, capacity)
+
+    def round_body(r, carry):
+        table_keys, table_meta, pending, found, inserted = carry
+        # triangular probing: offset r(r+1)/2 cycles a power-of-two table
+        slot = (home + (r * (r + 1)) // 2) & (capacity - 1)
+        cur = table_keys[slot]  # [B, 4]
+        match = jnp.all(cur == keys, axis=-1) & pending
+        empty = jnp.all(cur == 0, axis=-1) & pending
+        # elect one winner per contested empty slot: sort (slot, lane),
+        # first lane of each slot-run wins. Deterministic by construction.
+        lane = jnp.arange(b, dtype=jnp.int32)
+        # Push non-contenders to a slot value past the end so they never win.
+        contend_slot = jnp.where(empty, slot, capacity)
+        c_order = jnp.lexsort((lane, contend_slot))
+        c_slot = contend_slot[c_order]
+        c_first = jnp.concatenate(
+            [jnp.ones((1,), bool), c_slot[1:] != c_slot[:-1]]
+        )
+        winner_sorted = c_first & (c_slot < capacity)
+        winner = jnp.zeros((b,), bool).at[c_order].set(winner_sorted)
+        # Winners have unique slots: scatter keys + meta deterministically.
+        wslot = jnp.where(winner, slot, capacity)  # OOB rows are dropped
+        table_keys = table_keys.at[wslot].set(keys, mode="drop")
+        table_meta = table_meta.at[wslot].set(meta, mode="drop")
+        found = found | match
+        inserted = inserted | winner
+        pending = pending & ~match & ~winner
+        return table_keys, table_meta, pending, found, inserted
+
+    pending0 = rep
+    zeros = jnp.zeros((b,), bool)
+    table_keys, table_meta, pending, found, inserted = jax.lax.fori_loop(
+        0, max_probes, round_body,
+        (state.keys, state.meta, pending0, zeros, zeros),
+    )
+
+    was_unknown = inserted  # representatives that claimed a slot
+    overflowed = pending  # representatives that never found a home
+    new_count = state.count + jnp.sum(inserted, dtype=jnp.int32)
+    return TableState(table_keys, table_meta, new_count), was_unknown, overflowed
+
+
+@functools.partial(jax.jit, static_argnames=("max_probes",))
+def contains(state: TableState, keys: jax.Array, max_probes: int = 32) -> jax.Array:
+    """Batch membership query (no mutation): bool[B]."""
+    capacity = state.keys.shape[0]
+    keys = _desentinel(keys.astype(jnp.uint32))
+    home = _home_slot(keys, capacity)
+
+    def round_body(r, carry):
+        found, open_ = carry
+        slot = (home + (r * (r + 1)) // 2) & (capacity - 1)
+        cur = state.keys[slot]
+        match = jnp.all(cur == keys, axis=-1)
+        empty = jnp.all(cur == 0, axis=-1)
+        found = found | (match & open_)
+        open_ = open_ & ~match & ~empty
+        return found, open_
+
+    b = keys.shape[0]
+    found, _ = jax.lax.fori_loop(
+        0, max_probes, round_body, (jnp.zeros((b,), bool), jnp.ones((b,), bool))
+    )
+    return found
+
+
+def occupied(state: TableState) -> jax.Array:
+    """bool[capacity] occupancy mask."""
+    return jnp.any(state.keys != 0, axis=-1)
+
+
+def drain_np(state: TableState) -> tuple[np.ndarray, np.ndarray]:
+    """Pull (keys, meta) of occupied slots to host as NumPy arrays."""
+    keys = np.asarray(state.keys)
+    meta = np.asarray(state.meta)
+    occ = keys.any(axis=-1)
+    return keys[occ], meta[occ]

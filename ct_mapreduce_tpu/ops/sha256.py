@@ -1,0 +1,174 @@
+"""Batched SHA-256 as a pure-JAX op.
+
+The reference computes SHA-256 on the CPU per certificate (issuer
+identity = SHA-256(SPKI), /root/reference/storage/types.go:129-141).
+Here the digest runs on-device, vectorized over the batch axis: every
+lane is an independent message, all uint32 lane arithmetic, so XLA maps
+it onto the VPU with no cross-lane traffic.
+
+Two entry points:
+
+- ``sha256_blocks(blocks)``: the general compression over pre-padded
+  message blocks ``uint32[B, N, 16]`` → ``uint32[B, 8]``.
+- ``sha256_fingerprint64(words)``: the dedup-key path — a single
+  64-byte block per lane (enough for expHour ‖ issuerDigest ‖ serial,
+  which is ≤ 57 bytes) → the low 128 bits of the digest as
+  ``uint32[B, 4]``. Padding must already be applied by the packer.
+
+Host-side packers live in :mod:`ct_mapreduce_tpu.core.packing`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# FIPS 180-4 round constants.
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5,
+        0x3956C25B, 0x59F111F1, 0x923F82A4, 0xAB1C5ED5,
+        0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174,
+        0xE49B69C1, 0xEFBE4786, 0x0FC19DC6, 0x240CA1CC,
+        0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7,
+        0xC6E00BF3, 0xD5A79147, 0x06CA6351, 0x14292967,
+        0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85,
+        0xA2BFE8A1, 0xA81A664B, 0xC24B8B70, 0xC76C51A3,
+        0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5,
+        0x391C0CB3, 0x4ED8AA4A, 0x5B9CCA4F, 0x682E6FF3,
+        0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_H0 = np.array(
+    [
+        0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+        0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+    ],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x: jax.Array, n: int) -> jax.Array:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
+    """One SHA-256 compression. state: uint32[..., 8], block: uint32[..., 16]."""
+    w = [block[..., i] for i in range(16)]
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
+        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+
+    a, b, c, d, e, f, g, h = [state[..., i] for i in range(8)]
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + np.uint32(_K[t]) + w[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f = g, f, e
+        e = d + t1
+        d, c, b = c, b, a
+        a = t1 + t2
+    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+    return state + out
+
+
+@functools.partial(jax.jit, static_argnames=())
+def sha256_blocks(blocks: jax.Array) -> jax.Array:
+    """Digest pre-padded messages.
+
+    blocks: uint32[B, N, 16] big-endian message words, padding (0x80,
+    zeros, 64-bit bit length) already applied. Returns uint32[B, 8].
+    """
+    blocks = blocks.astype(jnp.uint32)
+    b = blocks.shape[0]
+    state = jnp.broadcast_to(jnp.asarray(_H0), (b, 8))
+
+    def step(st, blk):
+        return _compress(st, blk), None
+
+    state, _ = jax.lax.scan(step, state, jnp.swapaxes(blocks, 0, 1))
+    return state
+
+
+@jax.jit
+def sha256_var_blocks(blocks: jax.Array, n_blocks: jax.Array) -> jax.Array:
+    """Digest messages with per-lane block counts.
+
+    blocks: uint32[B, N, 16] where each lane's message occupies its
+    first ``n_blocks[lane]`` blocks (padding applied) and the remainder
+    is ignored. n_blocks: int32[B]. Returns uint32[B, 8].
+    """
+    blocks = blocks.astype(jnp.uint32)
+    b, n, _ = blocks.shape
+    state = jnp.broadcast_to(jnp.asarray(_H0), (b, 8))
+    n_blocks = n_blocks.astype(jnp.int32)
+
+    def step(st, xs):
+        i, blk = xs
+        new = _compress(st, blk)
+        keep = (i < n_blocks)[:, None]
+        return jnp.where(keep, new, st), None
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    state, _ = jax.lax.scan(step, state, (idx, jnp.swapaxes(blocks, 0, 1)))
+    return state
+
+
+@jax.jit
+def sha256_single_block(block: jax.Array) -> jax.Array:
+    """Digest one pre-padded 64-byte block per lane.
+
+    block: uint32[B, 16] → uint32[B, 8]. The hot path for dedup
+    fingerprints (message ≤ 55 bytes fits one block with padding).
+    """
+    block = block.astype(jnp.uint32)
+    state = jnp.broadcast_to(jnp.asarray(_H0), block.shape[:-1] + (8,))
+    return _compress(state, block)
+
+
+@jax.jit
+def sha256_fingerprint64(block: jax.Array) -> jax.Array:
+    """Low 128 bits (words 4..7) of the single-block digest: uint32[B, 4].
+
+    Truncation keeps the dedup key compact; collision probability over a
+    full CT log (~2^33 entries) is ≪ 2^-60, far below the
+    issuer-count-parity gate (SURVEY.md §7 hard part #2).
+    """
+    return sha256_single_block(block)[..., 4:]
+
+
+def pad_message_np(msg: bytes, total_blocks: int | None = None) -> np.ndarray:
+    """Host-side FIPS padding: bytes → uint32[N, 16] big-endian words."""
+    bitlen = len(msg) * 8
+    data = bytearray(msg)
+    data.append(0x80)
+    while len(data) % 64 != 56:
+        data.append(0)
+    data += bitlen.to_bytes(8, "big")
+    arr = np.frombuffer(bytes(data), dtype=">u4").astype(np.uint32)
+    arr = arr.reshape(-1, 16)
+    if total_blocks is not None:
+        if arr.shape[0] > total_blocks:
+            raise ValueError(f"message needs {arr.shape[0]} blocks > {total_blocks}")
+        pad = np.zeros((total_blocks - arr.shape[0], 16), dtype=np.uint32)
+        arr = np.concatenate([arr, pad], axis=0)
+    return arr
+
+
+def digest_np(state: np.ndarray) -> bytes:
+    """uint32[8] state → 32-byte big-endian digest."""
+    return np.asarray(state, dtype=">u4").tobytes()

@@ -1,0 +1,153 @@
+"""Dedup hash-table op: Redis SADD semantics on device.
+
+Parity oracle is a plain Python set, mirroring how the reference's
+MockRemoteCache stands in for Redis
+(/root/reference/storage/mockcache.go)."""
+
+import numpy as np
+import pytest
+
+from ct_mapreduce_tpu.ops import hashtable as ht
+
+
+def rand_keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
+
+
+def as_tuple(k):
+    return tuple(int(x) for x in k)
+
+
+def test_insert_then_reinsert():
+    state = ht.make_table(256)
+    keys = rand_keys(16)
+    valid = np.ones(16, bool)
+    meta = np.arange(16, dtype=np.uint32)
+    state, unknown, overflow = ht.insert(state, keys, meta, valid)
+    assert np.asarray(unknown).all()
+    assert not np.asarray(overflow).any()
+    assert int(state.count) == 16
+    # Second insert of the same keys: all known.
+    state, unknown2, overflow2 = ht.insert(state, keys, meta, valid)
+    assert not np.asarray(unknown2).any()
+    assert not np.asarray(overflow2).any()
+    assert int(state.count) == 16
+
+
+def test_within_batch_duplicates():
+    state = ht.make_table(256)
+    base = rand_keys(4, seed=1)
+    keys = np.concatenate([base, base, base[:2]])  # lanes: 4 uniq + 4 dup + 2 dup
+    valid = np.ones(len(keys), bool)
+    meta = np.zeros(len(keys), np.uint32)
+    state, unknown, _ = ht.insert(state, keys, meta, valid)
+    unknown = np.asarray(unknown)
+    # Exactly one lane per distinct key reports unknown.
+    assert unknown.sum() == 4
+    seen = set()
+    for i, k in enumerate(keys):
+        t = as_tuple(k)
+        if unknown[i]:
+            assert t not in seen
+        seen.add(t)
+    assert int(state.count) == 4
+
+
+def test_invalid_lanes_ignored():
+    state = ht.make_table(64)
+    keys = rand_keys(8, seed=2)
+    valid = np.array([True, False] * 4)
+    meta = np.zeros(8, np.uint32)
+    state, unknown, _ = ht.insert(state, keys, meta, valid)
+    unknown = np.asarray(unknown)
+    assert unknown[valid].all()
+    assert not unknown[~valid].any()
+    assert int(state.count) == 4
+
+
+def test_invalid_then_valid_same_key():
+    # An invalid lane must not "claim" a key for a later valid lane.
+    state = ht.make_table(64)
+    k = rand_keys(1, seed=3)
+    keys = np.concatenate([k, k])
+    valid = np.array([False, True])
+    meta = np.zeros(2, np.uint32)
+    state, unknown, _ = ht.insert(state, keys, meta, valid)
+    assert list(np.asarray(unknown)) == [False, True]
+    assert int(state.count) == 1
+
+
+def test_collision_pressure_tiny_table():
+    # 64-slot table, fill 48 slots across batches with forced probing.
+    state = ht.make_table(64)
+    oracle = set()
+    rng = np.random.default_rng(7)
+    for batch in range(6):
+        keys = rng.integers(0, 4, size=(8, 4), dtype=np.uint32)  # heavy dups
+        keys[:, 0] = rng.integers(0, 2**32, size=8, dtype=np.uint32)
+        valid = np.ones(8, bool)
+        meta = np.zeros(8, np.uint32)
+        state, unknown, overflow = ht.insert(state, keys, meta, valid)
+        unknown, overflow = np.asarray(unknown), np.asarray(overflow)
+        batch_seen = set()
+        for i, kk in enumerate(keys):
+            t = as_tuple(kk)
+            if overflow[i]:
+                continue
+            expect = t not in oracle and t not in batch_seen
+            assert bool(unknown[i]) == expect, (batch, i)
+            batch_seen.add(t)
+        oracle |= batch_seen
+    assert int(state.count) == len(
+        [1 for _ in oracle]
+    ) or int(state.count) <= len(oracle)  # overflowed reps may be missing
+
+
+def test_contains():
+    state = ht.make_table(128)
+    keys = rand_keys(32, seed=5)
+    state, _, _ = ht.insert(
+        state, keys[:16], np.zeros(16, np.uint32), np.ones(16, bool)
+    )
+    got = np.asarray(ht.contains(state, keys))
+    assert got[:16].all()
+    assert not got[16:].any()
+
+
+def test_meta_scattered_and_drain():
+    state = ht.make_table(128)
+    keys = rand_keys(10, seed=6)
+    meta = (np.arange(10, dtype=np.uint32) << 8) | 7
+    state, _, _ = ht.insert(state, keys, meta, np.ones(10, bool))
+    got_keys, got_meta = ht.drain_np(state)
+    assert got_keys.shape[0] == 10
+    by_key = {as_tuple(k): int(m) for k, m in zip(got_keys, got_meta)}
+    for k, m in zip(keys, meta):
+        assert by_key[as_tuple(k)] == int(m)
+
+
+def test_randomized_parity_vs_python_set():
+    state = ht.make_table(1024)
+    oracle = set()
+    rng = np.random.default_rng(11)
+    pool = rand_keys(300, seed=12)  # draw with replacement → cross-batch dups
+    for _ in range(10):
+        idx = rng.integers(0, len(pool), size=64)
+        keys = pool[idx]
+        valid = rng.random(64) > 0.1
+        meta = np.zeros(64, np.uint32)
+        state, unknown, overflow = ht.insert(state, keys, meta, valid)
+        unknown, overflow = np.asarray(unknown), np.asarray(overflow)
+        assert not overflow.any()  # plenty of capacity
+        batch_first = {}
+        for i in range(64):
+            t = as_tuple(keys[i])
+            if not valid[i]:
+                assert not unknown[i]
+                continue
+            expect = t not in oracle and t not in batch_first
+            assert bool(unknown[i]) == expect
+            batch_first[t] = True
+        oracle |= set(batch_first)
+    assert int(state.count) == len(oracle)

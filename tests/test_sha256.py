@@ -1,0 +1,57 @@
+"""Device SHA-256 vs hashlib (the kernel-parity tier, SURVEY.md §4)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from ct_mapreduce_tpu.ops import sha256 as dsha
+
+
+def _ref(msg: bytes) -> bytes:
+    return hashlib.sha256(msg).digest()
+
+
+@pytest.mark.parametrize(
+    "msg",
+    [
+        b"",
+        b"abc",
+        b"a" * 55,  # max single-block payload
+        b"a" * 56,  # first length that spills to 2 blocks
+        b"a" * 64,
+        b"hello world" * 13,
+        bytes(range(256)) * 5,
+    ],
+)
+def test_blocks_matches_hashlib(msg):
+    blocks = dsha.pad_message_np(msg)[None, ...]
+    out = np.asarray(dsha.sha256_blocks(blocks))
+    assert dsha.digest_np(out[0]) == _ref(msg)
+
+
+def test_batched_blocks():
+    msgs = [b"x" * i for i in range(0, 50, 7)]
+    blocks = np.stack([dsha.pad_message_np(m, total_blocks=1) for m in msgs])
+    out = np.asarray(dsha.sha256_blocks(blocks))
+    for i, m in enumerate(msgs):
+        assert dsha.digest_np(out[i]) == _ref(m)
+
+
+def test_var_blocks():
+    msgs = [b"", b"q" * 30, b"r" * 70, b"s" * 200, b"t" * 119]
+    nmax = 4
+    blocks = np.stack([dsha.pad_message_np(m, total_blocks=nmax) for m in msgs])
+    n_blocks = np.array([len(dsha.pad_message_np(m)) for m in msgs], dtype=np.int32)
+    out = np.asarray(dsha.sha256_var_blocks(blocks, n_blocks))
+    for i, m in enumerate(msgs):
+        assert dsha.digest_np(out[i]) == _ref(m)
+
+
+def test_single_block_and_fingerprint():
+    msg = b"fingerprint-me" * 3  # 42 bytes, single block
+    block = dsha.pad_message_np(msg, total_blocks=1)[0][None, ...]
+    full = np.asarray(dsha.sha256_single_block(block))
+    assert dsha.digest_np(full[0]) == _ref(msg)
+    fp = np.asarray(dsha.sha256_fingerprint64(block))
+    assert dsha.digest_np(full[0])[16:] == np.asarray(fp[0], dtype=">u4").tobytes()
