@@ -1,0 +1,123 @@
+"""Device DER walker vs the host reference lane (kernel-parity tier).
+
+Every field the device kernel extracts is checked byte-for-byte against
+:mod:`ct_mapreduce_tpu.core.der` on generated certificates spanning the
+structural variations the walker must handle (serial lengths/leading
+zeros, UTCTime vs GeneralizedTime, CA flags, CRL DPs, no-extensions)."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from ct_mapreduce_tpu.core import der as hostder
+from ct_mapreduce_tpu.ops import der_kernel
+
+from certgen import make_cert
+
+UTC = datetime.timezone.utc
+
+
+def pack(ders, pad_to=None):
+    maxlen = max(len(d) for d in ders)
+    l = pad_to or ((maxlen + 127) // 128 * 128)
+    data = np.zeros((len(ders), l), dtype=np.uint8)
+    length = np.zeros((len(ders),), dtype=np.int32)
+    for i, d in enumerate(ders):
+        data[i, : len(d)] = np.frombuffer(d, dtype=np.uint8)
+        length[i] = len(d)
+    return data, length
+
+
+def fixture_certs():
+    certs = [
+        make_cert(serial=0xDEADBEEF),
+        make_cert(serial=0x00AA00BB, issuer_cn="Leading Zero CA"),  # leading-zero serial
+        make_cert(serial=1),
+        make_cert(serial=(1 << 152) - 5),  # 20-byte serial
+        make_cert(is_ca=False, subject_cn="leaf.example.com"),
+        make_cert(add_basic_constraints=False),
+        make_cert(crl_dps=("http://crl.example.com/ca.crl",)),
+        make_cert(
+            crl_dps=("http://crl.example.com/a.crl", "https://crl2.example.com/b.crl")
+        ),
+        # GeneralizedTime: notAfter ≥ 2050 forces it (RFC 5280 §4.1.2.5)
+        make_cert(not_after=datetime.datetime(2055, 6, 1, 13, 37, tzinfo=UTC)),
+        # UTCTime upper range
+        make_cert(not_after=datetime.datetime(2049, 12, 31, 23, 59, tzinfo=UTC)),
+        make_cert(issuer_cn="日本語テストCA"),  # UTF8String CN
+    ]
+    return certs
+
+
+def test_parity_with_host_lane():
+    ders = fixture_certs()
+    data, length = pack(ders)
+    out = der_kernel.parse_certs(data, length)
+    for i, der in enumerate(ders):
+        ref = hostder.parse_cert(der)
+        assert bool(out.ok[i]), f"lane {i} rejected"
+        assert int(out.serial_off[i]) == ref.serial_off, i
+        assert int(out.serial_len[i]) == ref.serial_len, i
+        assert int(out.not_after_hour[i]) == ref.not_after_unix_hour, i
+        assert bool(out.is_ca[i]) == ref.is_ca, i
+        assert bool(out.has_crldp[i]) == bool(ref.crl_distribution_points), i
+        assert int(out.spki_off[i]) == ref.spki_off, i
+        assert int(out.spki_len[i]) == ref.spki_len, i
+        # CN bytes
+        cn = der[
+            int(out.issuer_cn_off[i]) : int(out.issuer_cn_off[i])
+            + int(out.issuer_cn_len[i])
+        ].decode("utf-8")
+        assert cn == ref.issuer_cn, i
+
+
+def test_serial_gather():
+    ders = fixture_certs()
+    data, length = pack(ders)
+    out = der_kernel.parse_certs(data, length)
+    serials, fits = der_kernel.gather_serials(
+        data, np.asarray(out.serial_off), np.asarray(out.serial_len)
+    )
+    serials, fits = np.asarray(serials), np.asarray(fits)
+    for i, der in enumerate(ders):
+        assert fits[i]
+        want = hostder.raw_serial_bytes(der)
+        got = serials[i, : int(out.serial_len[i])].tobytes()
+        assert got == want, i
+        assert not serials[i, int(out.serial_len[i]) :].any()
+
+
+def test_garbage_rejected_not_crashed():
+    rng = np.random.default_rng(3)
+    garbage = [rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+               for n in (0, 1, 5, 100, 700)]
+    # Prepend a plausible-but-truncated cert.
+    real = make_cert()
+    garbage.append(real[: len(real) // 2])
+    data, length = pack(garbage, pad_to=1024)
+    out = der_kernel.parse_certs(data, length)
+    # No lane may claim ok on structural nonsense (prob. of a random
+    # byte string forming a valid TBS prefix is negligible).
+    assert not np.asarray(out.ok).any()
+
+
+def test_mixed_good_and_bad_lanes():
+    good = fixture_certs()[:3]
+    bad = [b"\x30\x03\x01\x01\xff", b""]
+    ders = [good[0], bad[0], good[1], bad[1], good[2]]
+    data, length = pack(ders, pad_to=1024)
+    out = der_kernel.parse_certs(data, length)
+    ok = np.asarray(out.ok)
+    assert list(ok) == [True, False, True, False, True]
+
+
+def test_long_form_lengths():
+    # A cert comfortably > 256 bytes exercises 0x82 long-form at the
+    # outer SEQUENCE; all fixtures do. Also verify a tiny synthetic TLV
+    # with 0x81 form passes the header reader via a real cert re-pack.
+    der = make_cert()
+    assert der[1] in (0x81, 0x82)
+    data, length = pack([der])
+    out = der_kernel.parse_certs(data, length)
+    assert bool(out.ok[0])
