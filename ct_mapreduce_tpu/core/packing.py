@@ -1,0 +1,132 @@
+"""Host-side batch packing and the fingerprint/meta schemas.
+
+The device pipeline consumes fixed-shape batches; this module is the
+single source of truth for their layout, shared by the device ops, the
+host reference lane, and the tests:
+
+- **Entry batch**: zero-padded DER bytes ``uint8[B, L]`` + per-lane
+  true length, issuer index, and validity mask. ``L`` is chosen from
+  power-of-two-ish buckets so XLA compiles a handful of shapes total
+  (the streaming analog of the reference's fixed 1000-entry download
+  batches, /root/reference/cmd/ct-fetch/ct-fetch.go:417).
+- **Fingerprint message** (dedup key): ``expHour(4B BE) ‖
+  issuerIdx(4B BE) ‖ serialLen(1B) ‖ serial(≤46B)`` hashed with
+  SHA-256, low 128 bits kept. Equality of this message ⇔ equality of
+  the reference's Redis member ``(serials::<exp>::<issuer>, serial)``
+  triple (/root/reference/storage/knowncertificates.go:28-55), given
+  the run's issuer registry.
+- **Meta word**: ``issuerIdx(14b) | expHourOffset(18b)`` stored next
+  to each table key so drains can rebuild exact per-(issuer, expDate)
+  serial counts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+import numpy as np
+
+MAX_SERIAL_BYTES = 46  # fits a single SHA-256 block with the prefix
+FP_MSG_BYTES = 9 + MAX_SERIAL_BYTES  # ≤ 55 ⇒ single block after padding
+
+META_ISSUER_BITS = 14
+META_HOUR_BITS = 18
+MAX_ISSUERS = 1 << META_ISSUER_BITS
+META_HOUR_SPAN = 1 << META_HOUR_BITS  # ~29.9 years of hour buckets
+
+# Default epoch-hour base for the meta word: 2015-08-02T16:00Z. Any cert
+# expiring within ~30 years of that is representable; others take the
+# host lane.
+DEFAULT_BASE_HOUR = 400_000
+
+LENGTH_BUCKETS = (512, 768, 1024, 1536, 2048, 3072, 4096, 6144, 8192)
+
+
+def length_bucket(n: int) -> int:
+    for b in LENGTH_BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(f"certificate of {n} bytes exceeds the largest bucket")
+
+
+@dataclass
+class PackedBatch:
+    """A fixed-shape device batch (all NumPy; device_put by the caller)."""
+
+    data: np.ndarray  # uint8[B, L]
+    length: np.ndarray  # int32[B]
+    issuer_idx: np.ndarray  # int32[B]
+    valid: np.ndarray  # bool[B]
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+
+def pack_entries(
+    entries: list[tuple[bytes, int]],
+    batch_size: int | None = None,
+    pad_len: int | None = None,
+) -> PackedBatch:
+    """Pack (der, issuer_idx) pairs into a device batch.
+
+    Lanes beyond ``len(entries)`` are padding (valid=False). Entries
+    longer than ``pad_len`` (when forced) raise — callers should route
+    such certs to the host lane before packing.
+    """
+    n = len(entries)
+    b = batch_size or n
+    if n > b:
+        raise ValueError(f"{n} entries > batch size {b}")
+    maxlen = max((len(d) for d, _ in entries), default=1)
+    l = pad_len or length_bucket(maxlen)
+    if maxlen > l:
+        raise ValueError(f"entry of {maxlen} bytes > pad length {l}")
+    data = np.zeros((b, l), dtype=np.uint8)
+    length = np.zeros((b,), dtype=np.int32)
+    issuer_idx = np.zeros((b,), dtype=np.int32)
+    valid = np.zeros((b,), dtype=bool)
+    for i, (der, idx) in enumerate(entries):
+        data[i, : len(der)] = np.frombuffer(der, dtype=np.uint8)
+        length[i] = len(der)
+        issuer_idx[i] = idx
+        valid[i] = True
+    return PackedBatch(data, length, issuer_idx, valid)
+
+
+def pack_meta(issuer_idx: int, exp_hour: int, base_hour: int = DEFAULT_BASE_HOUR) -> int:
+    off = exp_hour - base_hour
+    if not (0 <= off < META_HOUR_SPAN):
+        raise ValueError(f"exp hour {exp_hour} outside meta span from {base_hour}")
+    if not (0 <= issuer_idx < MAX_ISSUERS):
+        raise ValueError(f"issuer index {issuer_idx} out of range")
+    return (issuer_idx << META_HOUR_BITS) | off
+
+
+def unpack_meta(meta: int, base_hour: int = DEFAULT_BASE_HOUR) -> tuple[int, int]:
+    """meta word → (issuer_idx, exp_hour)."""
+    return meta >> META_HOUR_BITS, (meta & (META_HOUR_SPAN - 1)) + base_hour
+
+
+def fingerprint_message(issuer_idx: int, exp_hour: int, serial: bytes) -> bytes:
+    if len(serial) > MAX_SERIAL_BYTES:
+        raise ValueError(f"serial of {len(serial)} bytes needs the host lane")
+    return (
+        int(exp_hour).to_bytes(4, "big", signed=True)
+        + int(issuer_idx).to_bytes(4, "big")
+        + bytes([len(serial)])
+        + serial
+    )
+
+
+def fingerprint_host(issuer_idx: int, exp_hour: int, serial: bytes) -> tuple[int, ...]:
+    """Host reference of the device fingerprint: 4 uint32 words.
+
+    Must match :func:`ct_mapreduce_tpu.ops.pipeline.fingerprints`
+    exactly — the kernel-parity tests enforce it.
+    """
+    digest = hashlib.sha256(fingerprint_message(issuer_idx, exp_hour, serial)).digest()
+    return tuple(
+        int.from_bytes(digest[16 + 4 * i : 20 + 4 * i], "big") for i in range(4)
+    )

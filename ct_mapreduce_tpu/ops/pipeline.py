@@ -1,0 +1,193 @@
+"""The fused device ingest step: the reference's hot loop #2 as one op.
+
+One jitted call does what ``insertCTWorker`` + ``FilesystemDatabase.Store``
+do per certificate (/root/reference/cmd/ct-fetch/ct-fetch.go:180-246,
+/root/reference/storage/filesystemdatabase.go:158-211), for a whole
+batch at once and with no per-entry host round trips:
+
+  parse DER → filter (CA / expired / issuer-CN prefix,
+  /root/reference/cmd/ct-fetch/ct-fetch.go:44-70) → gather serial →
+  build fingerprint block → SHA-256 → dedup-table insert-if-absent →
+  per-issuer new-cert counts.
+
+Lanes the device cannot handle exactly (parse failure, oversized
+serial, meta-range overflow, probe overflow) come back in
+``host_lane`` and are re-processed by the exact host path — the same
+tolerate-and-redirect contract the reference applies to unparseable
+entries (/root/reference/cmd/ct-fetch/ct-fetch.go:206-225).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ct_mapreduce_tpu.core import packing
+from ct_mapreduce_tpu.ops import der_kernel, hashtable, sha256
+
+
+class StepOut(NamedTuple):
+    was_unknown: jax.Array  # bool[B] — device-confirmed first sighting
+    host_lane: jax.Array  # bool[B] — lane needs the exact host path
+    filtered_ca: jax.Array  # bool[B]
+    filtered_expired: jax.Array  # bool[B]
+    filtered_cn: jax.Array  # bool[B]
+    stored: jax.Array  # bool[B] — passed filters, device-handled
+    not_after_hour: jax.Array  # int32[B]
+    serials: jax.Array  # uint8[B, MAX_SERIAL_BYTES] (for PEM/host use)
+    serial_len: jax.Array  # int32[B]
+    issuer_unknown_counts: jax.Array  # int32[num_issuers]
+    has_crldp: jax.Array  # bool[B]
+
+
+def fingerprints(
+    issuer_idx: jax.Array, exp_hour: jax.Array, serials: jax.Array, serial_len: jax.Array
+) -> jax.Array:
+    """Build fingerprint blocks on device and hash them: uint32[B, 4].
+
+    Message layout must match
+    :func:`ct_mapreduce_tpu.core.packing.fingerprint_message`.
+    """
+    b = issuer_idx.shape[0]
+    msg = jnp.zeros((b, 64), dtype=jnp.uint8)
+    eh = exp_hour.astype(jnp.uint32)
+    ii = issuer_idx.astype(jnp.uint32)
+    head = jnp.stack(
+        [
+            (eh >> 24) & 0xFF, (eh >> 16) & 0xFF, (eh >> 8) & 0xFF, eh & 0xFF,
+            (ii >> 24) & 0xFF, (ii >> 16) & 0xFF, (ii >> 8) & 0xFF, ii & 0xFF,
+            serial_len.astype(jnp.uint32) & 0xFF,
+        ],
+        axis=1,
+    ).astype(jnp.uint8)
+    msg = msg.at[:, :9].set(head)
+    msg = msg.at[:, 9 : 9 + packing.MAX_SERIAL_BYTES].set(serials)
+    # FIPS padding: 0x80 right after the message, bit length in the
+    # last two bytes (messages are < 2^13 bits).
+    msg_len = 9 + serial_len
+    pos = jnp.arange(64, dtype=jnp.int32)[None, :]
+    msg = jnp.where(pos == msg_len[:, None], jnp.uint8(0x80), msg)
+    bits = (msg_len * 8).astype(jnp.uint32)
+    msg = msg.at[:, 62].set(((bits >> 8) & 0xFF).astype(jnp.uint8))
+    msg = msg.at[:, 63].set((bits & 0xFF).astype(jnp.uint8))
+    words = msg.reshape(b, 16, 4).astype(jnp.uint32)
+    block = (
+        (words[:, :, 0] << 24) | (words[:, :, 1] << 16)
+        | (words[:, :, 2] << 8) | words[:, :, 3]
+    )
+    return sha256.sha256_fingerprint64(block)
+
+
+def _cn_prefix_match(
+    data: jax.Array, cn_off: jax.Array, cn_len: jax.Array,
+    prefixes: jax.Array, prefix_lens: jax.Array,
+) -> jax.Array:
+    """Does the issuer CN start with any configured prefix? bool[B].
+
+    prefixes: uint8[P, K]; prefix_lens: int32[P]. P == 0 handled by the
+    caller (filter disabled).
+    """
+    b, l = data.shape
+    k = prefixes.shape[1]
+    idx = cn_off[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    window = jnp.take_along_axis(data, jnp.clip(idx, 0, l - 1), axis=1)  # [B, K]
+    inside = jnp.arange(k, dtype=jnp.int32)[None, :] < cn_len[:, None]
+    window = jnp.where(inside, window, 0)
+    # [B, P, K] compare, masked beyond each prefix's length
+    eq = window[:, None, :] == prefixes[None, :, :]
+    care = jnp.arange(k, dtype=jnp.int32)[None, None, :] < prefix_lens[None, :, None]
+    full = jnp.all(eq | ~care, axis=-1)  # [B, P]
+    long_enough = cn_len[:, None] >= prefix_lens[None, :]
+    return jnp.any(full & long_enough, axis=-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_issuers", "max_probes"),
+    donate_argnums=(0,),
+)
+def ingest_step(
+    table: hashtable.TableState,
+    data: jax.Array,
+    length: jax.Array,
+    issuer_idx: jax.Array,
+    valid: jax.Array,
+    now_hour: jax.Array,
+    base_hour: jax.Array,
+    cn_prefixes: jax.Array,
+    cn_prefix_lens: jax.Array,
+    num_issuers: int = packing.MAX_ISSUERS,
+    max_probes: int = 32,
+) -> tuple[hashtable.TableState, StepOut]:
+    """Process one packed batch end-to-end on device.
+
+    Args:
+      table: dedup state (donated).
+      data/length/issuer_idx/valid: the packed batch.
+      now_hour: scalar int32 — "now" for the expiry filter (the
+        reference filters ``NotAfter.Before(now)``).
+      base_hour: scalar int32 — meta-word epoch base.
+      cn_prefixes/cn_prefix_lens: uint8[P, K]/int32[P]; P == 0 disables
+        the CN filter (shape is static ⇒ config changes recompile once).
+    """
+    parsed = der_kernel.parse_certs(data, length)
+    ok = parsed.ok & valid
+
+    serials, fits = der_kernel.gather_serials(
+        data, parsed.serial_off, parsed.serial_len, packing.MAX_SERIAL_BYTES
+    )
+
+    # --- filters, in the reference's precedence order -------------------
+    f_ca = ok & parsed.is_ca
+    f_expired = ok & ~f_ca & (parsed.not_after_hour < now_hour)
+    p = cn_prefixes.shape[0]
+    if p > 0:
+        cn_hit = _cn_prefix_match(
+            data, parsed.issuer_cn_off, parsed.issuer_cn_len,
+            cn_prefixes, cn_prefix_lens,
+        )
+        f_cn = ok & ~f_ca & ~f_expired & ~cn_hit
+    else:
+        f_cn = jnp.zeros_like(ok)
+    passed = ok & ~f_ca & ~f_expired & ~f_cn
+
+    # --- device-exactness gate ------------------------------------------
+    hour_off = parsed.not_after_hour - base_hour
+    meta_ok = (hour_off >= 0) & (hour_off < packing.META_HOUR_SPAN)
+    idx_ok = (issuer_idx >= 0) & (issuer_idx < num_issuers)
+    device_exact = fits & meta_ok & idx_ok
+    insertable = passed & device_exact
+
+    # --- fingerprint + dedup insert -------------------------------------
+    fps = fingerprints(issuer_idx, parsed.not_after_hour, serials, parsed.serial_len)
+    meta = (
+        (issuer_idx.astype(jnp.uint32) << packing.META_HOUR_BITS)
+        | (jnp.clip(hour_off, 0, packing.META_HOUR_SPAN - 1).astype(jnp.uint32))
+    )
+    table, was_unknown, overflowed = hashtable.insert(
+        table, fps, meta, insertable, max_probes=max_probes
+    )
+
+    host_lane = (valid & ~parsed.ok) | (passed & ~device_exact) | overflowed
+
+    issuer_counts = jnp.zeros((num_issuers,), jnp.int32).at[issuer_idx].add(
+        was_unknown.astype(jnp.int32), mode="drop"
+    )
+
+    return table, StepOut(
+        was_unknown=was_unknown,
+        host_lane=host_lane,
+        filtered_ca=f_ca,
+        filtered_expired=f_expired,
+        filtered_cn=f_cn,
+        stored=insertable & ~overflowed,
+        not_after_hour=parsed.not_after_hour,
+        serials=serials,
+        serial_len=parsed.serial_len,
+        issuer_unknown_counts=issuer_counts,
+        has_crldp=parsed.has_crldp,
+    )

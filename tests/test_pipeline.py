@@ -1,0 +1,137 @@
+"""Fused device ingest step vs the exact host path.
+
+The oracle replays the reference's per-cert logic
+(certIsFilteredOut + Store dedup,
+/root/reference/cmd/ct-fetch/ct-fetch.go:44-70,180-246) in Python and
+must agree lane-for-lane with the device step."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from ct_mapreduce_tpu.core import der as hostder
+from ct_mapreduce_tpu.core import packing
+from ct_mapreduce_tpu.ops import hashtable, pipeline
+
+from certgen import make_cert
+
+UTC = datetime.timezone.utc
+NOW = datetime.datetime(2024, 6, 1, tzinfo=UTC)
+NOW_HOUR = int(NOW.timestamp()) // 3600
+BASE = packing.DEFAULT_BASE_HOUR
+NO_PREFIX = (np.zeros((0, 32), np.uint8), np.zeros((0,), np.int32))
+
+
+def run_step(table, entries, prefixes=NO_PREFIX, batch_size=None):
+    batch = packing.pack_entries(entries, batch_size=batch_size)
+    table, out = pipeline.ingest_step(
+        table,
+        batch.data,
+        batch.length,
+        batch.issuer_idx,
+        batch.valid,
+        np.int32(NOW_HOUR),
+        np.int32(BASE),
+        prefixes[0],
+        prefixes[1],
+    )
+    return table, out
+
+
+def test_fingerprint_parity_with_host():
+    certs = [make_cert(serial=s) for s in (1, 0xAABB, 0x00AA00BB, (1 << 150) + 7)]
+    entries = [(c, i % 3) for i, c in enumerate(certs)]
+    import jax.numpy as jnp
+
+    batch = packing.pack_entries(entries)
+    from ct_mapreduce_tpu.ops import der_kernel
+
+    parsed = der_kernel.parse_certs(batch.data, batch.length)
+    serials, _ = der_kernel.gather_serials(
+        batch.data, parsed.serial_off, parsed.serial_len, packing.MAX_SERIAL_BYTES
+    )
+    fps = np.asarray(
+        pipeline.fingerprints(
+            jnp.asarray(batch.issuer_idx), parsed.not_after_hour, serials,
+            parsed.serial_len,
+        )
+    )
+    for i, (der, idx) in enumerate(entries):
+        ref = hostder.parse_cert(der)
+        want = packing.fingerprint_host(idx, ref.not_after_unix_hour, ref.serial)
+        assert tuple(int(x) for x in fps[i]) == want, i
+
+
+def test_dedup_and_filters_end_to_end():
+    table = hashtable.make_table(1 << 12)
+    good1 = make_cert(serial=100, is_ca=False, subject_cn="a.example.com")
+    good2 = make_cert(serial=101, is_ca=False, subject_cn="b.example.com")
+    ca = make_cert(serial=102, is_ca=True)
+    expired = make_cert(
+        serial=103, is_ca=False, subject_cn="old.example.com",
+        not_before=datetime.datetime(2020, 1, 1, tzinfo=UTC),
+        not_after=datetime.datetime(2021, 1, 1, tzinfo=UTC),
+    )
+    entries = [(good1, 0), (good2, 0), (ca, 0), (expired, 0), (good1, 0)]
+    table, out = run_step(table, entries, batch_size=8)
+
+    assert list(np.asarray(out.filtered_ca)[:5]) == [False, False, True, False, False]
+    assert list(np.asarray(out.filtered_expired)[:5]) == [
+        False, False, False, True, False,
+    ]
+    # lane 4 duplicates lane 0 within the batch → known
+    assert list(np.asarray(out.was_unknown)[:5]) == [True, True, False, False, False]
+    assert not np.asarray(out.host_lane).any()
+    assert int(table.count) == 2
+
+    # Re-ingesting the same batch: nothing new.
+    table, out2 = run_step(table, entries, batch_size=8)
+    assert not np.asarray(out2.was_unknown).any()
+    assert int(table.count) == 2
+
+
+def test_issuer_counts():
+    table = hashtable.make_table(1 << 12)
+    entries = []
+    for i in range(6):
+        entries.append(
+            (make_cert(serial=200 + i, is_ca=False, subject_cn=f"h{i}.example.com"),
+             i % 2)
+        )
+    table, out = run_step(table, entries)
+    counts = np.asarray(out.issuer_unknown_counts)
+    assert counts[0] == 3 and counts[1] == 3
+    assert counts[2:].sum() == 0
+
+
+def test_cn_prefix_filter():
+    table = hashtable.make_table(1 << 12)
+    keep = make_cert(serial=300, is_ca=False, issuer_cn="KeepMe CA 1")
+    drop = make_cert(serial=301, is_ca=False, issuer_cn="DropMe CA 1")
+    prefixes = np.zeros((2, 32), np.uint8)
+    for i, pfx in enumerate([b"KeepMe", b"Other"]):
+        prefixes[i, : len(pfx)] = np.frombuffer(pfx, np.uint8)
+    plens = np.array([6, 5], np.int32)
+    table, out = run_step(table, [(keep, 0), (drop, 0)], prefixes=(prefixes, plens))
+    assert list(np.asarray(out.filtered_cn)) == [False, True]
+    assert list(np.asarray(out.was_unknown)) == [True, False]
+
+
+def test_host_lane_on_garbage():
+    table = hashtable.make_table(1 << 12)
+    good = make_cert(serial=400, is_ca=False, subject_cn="x.example.com")
+    entries = [(good, 0), (b"\x30\x05junk", 0)]
+    table, out = run_step(table, entries)
+    assert list(np.asarray(out.host_lane)) == [False, True]
+    assert list(np.asarray(out.was_unknown)) == [True, False]
+
+
+def test_crldp_flag_surfaced():
+    table = hashtable.make_table(1 << 12)
+    with_dp = make_cert(
+        serial=500, is_ca=False, crl_dps=("http://crl.example.com/c.crl",)
+    )
+    without = make_cert(serial=501, is_ca=False, subject_cn="nodp.example.com")
+    table, out = run_step(table, [(with_dp, 0), (without, 0)])
+    assert list(np.asarray(out.has_crldp)) == [True, False]
