@@ -1,0 +1,6 @@
+from ct_mapreduce_tpu.agg.aggregator import (  # noqa: F401
+    AggregateSnapshot,
+    IngestResult,
+    IssuerRegistry,
+    TpuAggregator,
+)

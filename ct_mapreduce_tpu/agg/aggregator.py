@@ -1,0 +1,469 @@
+"""The on-device reduce state and its exact host fallback lane.
+
+``TpuAggregator`` replaces the Redis-resident reduce state of the
+reference (serial dedup sets, per-issuer CRL/DN sets,
+/root/reference/storage/knowncertificates.go,
+/root/reference/storage/issuermetadata.go) with:
+
+- an HBM-resident dedup hash table (:mod:`ct_mapreduce_tpu.ops.hashtable`)
+  driven by the fused ingest step (:mod:`ct_mapreduce_tpu.ops.pipeline`),
+- a host-side issuer registry mapping SHA-256(SPKI) identities to the
+  dense indices the device ops use,
+- host-side CRL/DN string sets (tiny, string-typed — SURVEY.md §7
+  layer 3 keeps them off-device), fed by device-extracted byte windows
+  so the host never re-parses a certificate it has seen the shape of,
+- an **exact host lane** for every lane the device flags
+  (parse failure / oversized serial / meta range / probe overflow),
+  preserving the reference's per-entry tolerance contract
+  (/root/reference/cmd/ct-fetch/ct-fetch.go:206-225).
+
+Determinism note: a certificate either always takes the device path or
+always takes the host path (the routing predicates are functions of the
+cert alone, except probe overflow — and an overflowed key stays
+overflowed, since the table only fills). The two dedup domains are
+therefore disjoint; a belt-and-braces host-set check on device-unknown
+lanes guards the pathological cross-encoding case.
+
+``drain()`` reconstructs exactly what ``storage-statistics`` prints
+(/root/reference/cmd/storage-statistics/storage-statistics.go:28-99):
+per-(issuer, expDate) serial counts from the table's meta words plus
+the host sets, and per-issuer CRL/DN sets.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+from ct_mapreduce_tpu.core import der as hostder
+from ct_mapreduce_tpu.core import packing
+from ct_mapreduce_tpu.core.types import ExpDate, Issuer
+from ct_mapreduce_tpu.ops import hashtable, pipeline
+from ct_mapreduce_tpu.telemetry.metrics import incr_counter
+
+
+class IssuerRegistry:
+    """Dense issuer indexing for device ops.
+
+    Maps issuer certificates (by raw DER, cached) to small integer
+    indices; index → :class:`Issuer` (base64url(SHA-256(SPKI)),
+    /root/reference/storage/types.go:104-141) for drains and reports.
+    """
+
+    def __init__(self) -> None:
+        self._by_der: dict[bytes, int] = {}
+        self._by_issuer_id: dict[str, int] = {}
+        self._issuers: list[Issuer] = []
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._issuers)
+
+    def get_or_assign(self, issuer_der: bytes) -> int:
+        with self._lock:
+            idx = self._by_der.get(issuer_der)
+            if idx is not None:
+                return idx
+            fields = hostder.parse_cert(issuer_der)
+            issuer = Issuer.from_spki(fields.spki)
+            iid = issuer.id()
+            idx = self._by_issuer_id.get(iid)
+            if idx is None:
+                idx = len(self._issuers)
+                if idx >= packing.MAX_ISSUERS:
+                    raise RuntimeError(
+                        f"issuer registry full ({packing.MAX_ISSUERS})"
+                    )
+                self._issuers.append(issuer)
+                self._by_issuer_id[iid] = idx
+            self._by_der[issuer_der] = idx
+            return idx
+
+    def index_of_issuer_id(self, issuer_id: str) -> Optional[int]:
+        return self._by_issuer_id.get(issuer_id)
+
+    def issuer_at(self, idx: int) -> Issuer:
+        return self._issuers[idx]
+
+    def to_json(self) -> str:
+        return json.dumps([iss.id() for iss in self._issuers])
+
+    @classmethod
+    def from_json(cls, raw: str) -> "IssuerRegistry":
+        reg = cls()
+        for iid in json.loads(raw):
+            idx = len(reg._issuers)
+            reg._issuers.append(Issuer.from_string(iid))
+            reg._by_issuer_id[iid] = idx
+        return reg
+
+
+@dataclass
+class IngestResult:
+    """Per-batch outcome, aligned with the input entry order."""
+
+    was_unknown: np.ndarray  # bool[n]
+    filtered: np.ndarray  # bool[n] — CA / expired / CN filter
+    exp_hours: np.ndarray  # int32[n] (0 where filtered/unparseable)
+    serials: list[Optional[bytes]]  # raw serial bytes per entry
+    issuer_idx: np.ndarray  # int32[n]
+    host_lane_count: int = 0
+
+
+@dataclass
+class AggregateSnapshot:
+    """Drained reduce state — the material of storage-statistics."""
+
+    counts: dict[tuple[str, str], int]  # (issuerID, expDateID) → serials
+    crls: dict[str, set[str]]  # issuerID → CRL DP URLs
+    dns: dict[str, set[str]]  # issuerID → issuer DN strings
+    total: int = 0
+
+    def issuers(self) -> list[str]:
+        out = {iss for iss, _ in self.counts}
+        out.update(self.crls)
+        out.update(self.dns)
+        return sorted(out)
+
+
+class TpuAggregator:
+    def __init__(
+        self,
+        capacity: int = 1 << 22,
+        batch_size: int = 4096,
+        base_hour: int = packing.DEFAULT_BASE_HOUR,
+        cn_prefixes: tuple[str, ...] = (),
+        max_probes: int = 32,
+        now: Optional[datetime] = None,
+    ) -> None:
+        self.table = hashtable.make_table(capacity)
+        self.capacity = capacity
+        self.batch_size = batch_size
+        self.base_hour = base_hour
+        self.max_probes = max_probes
+        self.registry = IssuerRegistry()
+        self._fixed_now = now
+        # Host-exact lane state: (issuer_idx, exp_hour) → set of serial bytes.
+        self.host_serials: dict[tuple[int, int], set[bytes]] = {}
+        # Per-issuer metadata (strings stay host-side).
+        self.crl_sets: dict[int, set[str]] = {}
+        self.dn_sets: dict[int, set[str]] = {}
+        self._crl_raw_seen: set[tuple[int, bytes]] = set()
+        self._dn_raw_seen: set[tuple[int, bytes]] = set()
+        # Device-side per-issuer unknown totals (running).
+        self.issuer_totals = np.zeros((packing.MAX_ISSUERS,), np.int64)
+        self.set_cn_prefixes(cn_prefixes)
+        self.metrics: dict[str, int] = {
+            "inserted": 0, "known": 0, "filtered_ca": 0, "filtered_expired": 0,
+            "filtered_cn": 0, "host_lane": 0, "parse_errors": 0, "overflow": 0,
+        }
+
+    # -- config ----------------------------------------------------------
+    def set_cn_prefixes(self, prefixes: tuple[str, ...]) -> None:
+        self.cn_prefixes = tuple(prefixes)
+        k = 32
+        arr = np.zeros((len(prefixes), k), np.uint8)
+        lens = np.zeros((len(prefixes),), np.int32)
+        for i, pfx in enumerate(prefixes):
+            b = pfx.encode("utf-8")[:k]
+            arr[i, : len(b)] = np.frombuffer(b, np.uint8)
+            lens[i] = len(b)
+        self._prefix_arr, self._prefix_lens = arr, lens
+
+    def _now_hour(self) -> int:
+        now = self._fixed_now or datetime.now(timezone.utc)
+        return int(now.timestamp()) // 3600
+
+    # -- ingest ----------------------------------------------------------
+    def ingest(self, entries: list[tuple[bytes, bytes]]) -> IngestResult:
+        """Process (leaf_der, issuer_der) pairs; any count, chunked
+        internally to the device batch size."""
+        n = len(entries)
+        was_unknown = np.zeros((n,), bool)
+        filtered = np.zeros((n,), bool)
+        exp_hours = np.zeros((n,), np.int32)
+        serials: list[Optional[bytes]] = [None] * n
+        issuer_idx = np.zeros((n,), np.int32)
+        host_lane_total = 0
+
+        for i, (_, issuer_der) in enumerate(entries):
+            issuer_idx[i] = self.registry.get_or_assign(issuer_der)
+
+        max_len = packing.LENGTH_BUCKETS[-1]
+        for start in range(0, n, self.batch_size):
+            chunk = entries[start : start + self.batch_size]
+            idxs = issuer_idx[start : start + len(chunk)]
+            device_entries, device_pos, host_pos = [], [], []
+            for j, (der, _) in enumerate(chunk):
+                if len(der) <= max_len:
+                    device_entries.append((der, int(idxs[j])))
+                    device_pos.append(start + j)
+                else:
+                    host_pos.append(start + j)
+            if device_entries:
+                out, batch = self._device_step(device_entries)
+                hl = np.asarray(out.host_lane)
+                wu = np.asarray(out.was_unknown)
+                nah = np.asarray(out.not_after_hour)
+                slen = np.asarray(out.serial_len)
+                sarr = np.asarray(out.serials)
+                f_any = (
+                    np.asarray(out.filtered_ca)
+                    | np.asarray(out.filtered_expired)
+                    | np.asarray(out.filtered_cn)
+                )
+                self.metrics["filtered_ca"] += int(np.asarray(out.filtered_ca).sum())
+                self.metrics["filtered_expired"] += int(
+                    np.asarray(out.filtered_expired).sum()
+                )
+                self.metrics["filtered_cn"] += int(np.asarray(out.filtered_cn).sum())
+                self.issuer_totals += np.asarray(
+                    out.issuer_unknown_counts, dtype=np.int64
+                )
+                for lane, pos in enumerate(device_pos):
+                    if hl[lane]:
+                        host_pos.append(pos)
+                        continue
+                    filtered[pos] = f_any[lane]
+                    if not f_any[lane]:
+                        exp_hours[pos] = nah[lane]
+                        serials[pos] = sarr[lane, : slen[lane]].tobytes()
+                        if wu[lane]:
+                            # Cross-encoding guard (see module docstring).
+                            key = (int(idxs[pos - start]), int(nah[lane]))
+                            if serials[pos] in self.host_serials.get(key, ()):
+                                wu[lane] = False
+                            else:
+                                was_unknown[pos] = True
+                self._accumulate_metadata(batch, out, device_pos, was_unknown)
+                dev_unknown = int(wu.sum())
+                dev_known = len(device_pos) - int(hl.sum()) - dev_unknown
+                self.metrics["inserted"] += dev_unknown
+                self.metrics["known"] += max(dev_known, 0)
+            # Exact host path for flagged + oversized lanes.
+            for pos in host_pos:
+                host_lane_total += 1
+                u, f, eh, sb = self._host_exact(
+                    entries[pos][0], int(issuer_idx[pos])
+                )
+                was_unknown[pos], filtered[pos] = u, f
+                exp_hours[pos], serials[pos] = eh, sb
+
+        self.metrics["host_lane"] += host_lane_total
+        incr_counter("aggregator", "batches")
+        return IngestResult(
+            was_unknown=was_unknown,
+            filtered=filtered,
+            exp_hours=exp_hours,
+            serials=serials,
+            issuer_idx=issuer_idx,
+            host_lane_count=host_lane_total,
+        )
+
+    def _device_step(self, device_entries):
+        batch = packing.pack_entries(
+            device_entries, batch_size=self.batch_size
+        )
+        self.table, out = pipeline.ingest_step(
+            self.table,
+            batch.data,
+            batch.length,
+            batch.issuer_idx,
+            batch.valid,
+            np.int32(self._now_hour()),
+            np.int32(self.base_hour),
+            self._prefix_arr,
+            self._prefix_lens,
+            max_probes=self.max_probes,
+        )
+        return out, batch
+
+    def _accumulate_metadata(self, batch, out, device_pos, was_unknown_global):
+        """CRL/DN accumulation for device-unknown lanes, keyed by raw
+        byte windows so each distinct encoding is parsed once."""
+        wu_lanes = [
+            lane for lane, pos in enumerate(device_pos) if was_unknown_global[pos]
+        ]
+        if not wu_lanes:
+            return
+        dp_off = np.asarray(out.crldp_off)
+        dp_len = np.asarray(out.crldp_len)
+        in_off = np.asarray(out.issuer_name_off)
+        in_len = np.asarray(out.issuer_name_len)
+        for lane in wu_lanes:
+            idx = int(batch.issuer_idx[lane])
+            row = batch.data[lane]
+            # issuer DN
+            raw_name = row[in_off[lane] : in_off[lane] + in_len[lane]].tobytes()
+            if (idx, raw_name) not in self._dn_raw_seen:
+                self._dn_raw_seen.add((idx, raw_name))
+                try:
+                    rdns, _ = hostder.parse_name(raw_name, 0)
+                    dn = hostder.render_dn(rdns)
+                    self.dn_sets.setdefault(idx, set()).add(dn)
+                except Exception:
+                    pass
+            # CRL DPs
+            if dp_len[lane] > 0:
+                raw_dp = row[dp_off[lane] : dp_off[lane] + dp_len[lane]].tobytes()
+                if (idx, raw_dp) not in self._crl_raw_seen:
+                    self._crl_raw_seen.add((idx, raw_dp))
+                    try:
+                        urls = hostder._parse_crldp(raw_dp, 0)
+                    except Exception:
+                        urls = []
+                    self._add_crls(idx, urls)
+
+    def _add_crls(self, issuer_idx: int, urls: list[str]) -> None:
+        """http/https only; ldap silently dropped
+        (/root/reference/storage/issuermetadata.go:48-73)."""
+        for u in urls:
+            try:
+                parsed = urlparse(u.strip())
+            except ValueError:
+                continue
+            if parsed.scheme in ("http", "https"):
+                self.crl_sets.setdefault(issuer_idx, set()).add(parsed.geturl())
+
+    def _host_exact(self, der: bytes, issuer_idx: int):
+        """The exact lane: tolerant host parse + reference filter +
+        host-set dedup. Returns (was_unknown, filtered, exp_hour, serial)."""
+        try:
+            fields = hostder.parse_cert(der)
+        except Exception:
+            self.metrics["parse_errors"] += 1
+            return False, False, 0, None
+        now_hour = self._now_hour()
+        if fields.is_ca:
+            self.metrics["filtered_ca"] += 1
+            return False, True, 0, None
+        eh = fields.not_after_unix_hour
+        if eh < now_hour:
+            self.metrics["filtered_expired"] += 1
+            return False, True, 0, None
+        if self.cn_prefixes and not any(
+            fields.issuer_cn.startswith(p) for p in self.cn_prefixes
+        ):
+            self.metrics["filtered_cn"] += 1
+            return False, True, 0, None
+        key = (issuer_idx, eh)
+        bucket = self.host_serials.setdefault(key, set())
+        if fields.serial in bucket:
+            self.metrics["known"] += 1
+            return False, False, eh, fields.serial
+        bucket.add(fields.serial)
+        self.metrics["inserted"] += 1
+        self.issuer_totals[issuer_idx] += 1
+        # Metadata for host-lane unknowns.
+        self.dn_sets.setdefault(issuer_idx, set()).add(fields.issuer_dn)
+        self._add_crls(issuer_idx, fields.crl_distribution_points)
+        return True, False, eh, fields.serial
+
+    # -- drain / report --------------------------------------------------
+    def drain(self) -> AggregateSnapshot:
+        """Pull device state to host and merge with the host lane —
+        the data storage-statistics prints
+        (/root/reference/cmd/storage-statistics/storage-statistics.go:28-99)."""
+        _, meta = hashtable.drain_np(self.table)
+        counts: dict[tuple[str, str], int] = {}
+        if meta.size:
+            uniq, cnt = np.unique(meta, return_counts=True)
+            for m, c in zip(uniq, cnt):
+                idx, eh = packing.unpack_meta(int(m), self.base_hour)
+                key = self._count_key(idx, eh)
+                counts[key] = counts.get(key, 0) + int(c)
+        for (idx, eh), serials in self.host_serials.items():
+            if not serials:
+                continue
+            key = self._count_key(idx, eh)
+            counts[key] = counts.get(key, 0) + len(serials)
+        crls = {
+            self.registry.issuer_at(i).id(): set(s) for i, s in self.crl_sets.items()
+        }
+        dns = {
+            self.registry.issuer_at(i).id(): set(s) for i, s in self.dn_sets.items()
+        }
+        return AggregateSnapshot(
+            counts=counts, crls=crls, dns=dns, total=sum(counts.values())
+        )
+
+    def _count_key(self, issuer_idx: int, exp_hour: int) -> tuple[str, str]:
+        return (
+            self.registry.issuer_at(issuer_idx).id(),
+            ExpDate.from_unix_hour(exp_hour).id(),
+        )
+
+    # -- checkpoint ------------------------------------------------------
+    def save_checkpoint(self, path: str) -> None:
+        """Device aggregates + registry + host lane to one .npz.
+
+        The log cursor itself is checkpointed separately (same contract
+        as the reference, /root/reference/storage/types.go:25-42); this
+        file makes device state restorable after preemption.
+        """
+        host_items = [
+            (idx, eh, b";".join(s.hex().encode() for s in sorted(serials)))
+            for (idx, eh), serials in self.host_serials.items()
+        ]
+        np.savez_compressed(
+            path,
+            keys=np.asarray(self.table.keys),
+            meta=np.asarray(self.table.meta),
+            count=np.asarray(self.table.count),
+            registry=np.frombuffer(
+                self.registry.to_json().encode(), dtype=np.uint8
+            ),
+            base_hour=np.int64(self.base_hour),
+            issuer_totals=self.issuer_totals,
+            host_keys=np.array(
+                [(i, e) for i, e, _ in host_items], dtype=np.int64
+            ).reshape(-1, 2),
+            host_vals=np.array([v for _, _, v in host_items], dtype=object),
+            crl_sets=np.frombuffer(
+                json.dumps(
+                    {str(k): sorted(v) for k, v in self.crl_sets.items()}
+                ).encode(),
+                dtype=np.uint8,
+            ),
+            dn_sets=np.frombuffer(
+                json.dumps(
+                    {str(k): sorted(v) for k, v in self.dn_sets.items()}
+                ).encode(),
+                dtype=np.uint8,
+            ),
+            allow_pickle=True,
+        )
+
+    def load_checkpoint(self, path: str) -> None:
+        import jax.numpy as jnp
+
+        z = np.load(path, allow_pickle=True)
+        self.table = hashtable.TableState(
+            keys=jnp.asarray(z["keys"]),
+            meta=jnp.asarray(z["meta"]),
+            count=jnp.asarray(z["count"]),
+        )
+        self.capacity = int(z["keys"].shape[0])
+        self.base_hour = int(z["base_hour"])
+        self.registry = IssuerRegistry.from_json(z["registry"].tobytes().decode())
+        self.issuer_totals = z["issuer_totals"].copy()
+        self.host_serials = {}
+        for (idx, eh), blob in zip(z["host_keys"], z["host_vals"]):
+            serials = {
+                bytes.fromhex(h.decode()) for h in blob.split(b";") if h
+            }
+            self.host_serials[(int(idx), int(eh))] = serials
+        self.crl_sets = {
+            int(k): set(v)
+            for k, v in json.loads(z["crl_sets"].tobytes().decode()).items()
+        }
+        self.dn_sets = {
+            int(k): set(v)
+            for k, v in json.loads(z["dn_sets"].tobytes().decode()).items()
+        }

@@ -55,8 +55,12 @@ class ParsedCerts(NamedTuple):
     has_crldp: jax.Array  # bool
     issuer_cn_off: jax.Array
     issuer_cn_len: jax.Array  # 0 ⇒ no CN present
+    issuer_off: jax.Array  # full issuer Name TLV (host DN-cache key)
+    issuer_len: jax.Array
     spki_off: jax.Array  # offset of the full SPKI TLV
     spki_len: jax.Array  # header+content length
+    crldp_off: jax.Array  # CRLDP extnValue content (host CRL-cache key)
+    crldp_len: jax.Array  # 0 ⇒ extension absent
 
 
 def _byte_at(data: jax.Array, p: jax.Array) -> jax.Array:
@@ -179,9 +183,10 @@ def _scan_extensions(data, ext_off, ext_end, alive0):
     """Walk SEQUENCE OF Extension for BasicConstraints CA + CRLDP presence."""
     b = data.shape[0]
     false = jnp.zeros((b,), bool)
+    zero = jnp.zeros((b,), jnp.int32)
 
     def body(_, carry):
-        p, is_ca, has_crldp, alive, budget_ok = carry
+        p, is_ca, has_crldp, dp_off, dp_len, alive = carry
         active = alive & (p < ext_end)
         tag, clen, hlen, hok = _read_header(data, p, ext_end)
         ext_ok = active & hok & (tag == 0x30)
@@ -211,19 +216,22 @@ def _scan_extensions(data, ext_off, ext_end, alive0):
             & (_byte_at(data, pflag + fhlen) != 0)
         )
         is_ca = is_ca | (is_bc & ca_flag)
+        take_dp = is_dp & val_ok & (dp_len == 0)
+        dp_off = jnp.where(take_dp, pv + vhlen, dp_off)
+        dp_len = jnp.where(take_dp, vclen, dp_len)
         has_crldp = has_crldp | (is_dp & val_ok)
         p = jnp.where(active & hok, p + hlen + clen, p)
         alive = alive & jnp.where(active, hok, True)
-        return p, is_ca, has_crldp, alive, budget_ok
+        return p, is_ca, has_crldp, dp_off, dp_len, alive
 
-    p, is_ca, has_crldp, alive, _ = jax.lax.fori_loop(
-        0, MAX_EXTS, body, (ext_off, false, false, alive0, false)
+    p, is_ca, has_crldp, dp_off, dp_len, alive = jax.lax.fori_loop(
+        0, MAX_EXTS, body, (ext_off, false, false, zero, zero, alive0)
     )
     # Lanes still inside the window after MAX_EXTS rounds exhausted the
     # loop budget — flag them (host lane) rather than silently missing
     # a trailing basicConstraints.
     exhausted = alive & (p < ext_end)
-    return is_ca, has_crldp, alive & ~exhausted
+    return is_ca, has_crldp, dp_off, dp_len, alive & ~exhausted
 
 
 @jax.jit
@@ -276,6 +284,8 @@ def parse_certs(data: jax.Array, length: jax.Array) -> ParsedCerts:
     # issuer Name — scanned for the first CN
     tag, clen, hlen, hok = _read_header(data, p, tbs_end)
     ok &= hok & (tag == 0x30)
+    issuer_off = p
+    issuer_len_out = hlen + clen
     issuer_inner = p + hlen
     issuer_end = p + hlen + clen
     cn_off, cn_len = _scan_issuer_cn(data, issuer_inner, issuer_end, ok)
@@ -319,7 +329,9 @@ def parse_certs(data: jax.Array, length: jax.Array) -> ParsedCerts:
     ok &= jnp.where(has_ext, eok & (etag == 0x30), True)
     ext_off = pe + ehlen
     ext_end = jnp.where(ext_listed, pe + ehlen + eclen, jnp.zeros((b,), jnp.int32))
-    is_ca, has_crldp, ext_ok = _scan_extensions(data, ext_off, ext_end, ok)
+    is_ca, has_crldp, dp_off, dp_len, ext_ok = _scan_extensions(
+        data, ext_off, ext_end, ok
+    )
     ok &= ext_ok
 
     return ParsedCerts(
@@ -331,8 +343,12 @@ def parse_certs(data: jax.Array, length: jax.Array) -> ParsedCerts:
         has_crldp=has_crldp & ok,
         issuer_cn_off=cn_off,
         issuer_cn_len=jnp.where(ok, cn_len, 0),
+        issuer_off=jnp.where(ok, issuer_off, 0),
+        issuer_len=jnp.where(ok, issuer_len_out, 0),
         spki_off=jnp.where(ok, spki_off, 0),
         spki_len=jnp.where(ok, spki_len, 0),
+        crldp_off=jnp.where(ok, dp_off, 0),
+        crldp_len=jnp.where(ok, dp_len, 0),
     )
 
 

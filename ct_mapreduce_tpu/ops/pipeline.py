@@ -42,6 +42,10 @@ class StepOut(NamedTuple):
     serial_len: jax.Array  # int32[B]
     issuer_unknown_counts: jax.Array  # int32[num_issuers]
     has_crldp: jax.Array  # bool[B]
+    crldp_off: jax.Array  # int32[B] — CRLDP extnValue window in `data`
+    crldp_len: jax.Array  # int32[B]
+    issuer_name_off: jax.Array  # int32[B] — issuer Name TLV window
+    issuer_name_len: jax.Array  # int32[B]
 
 
 def fingerprints(
@@ -190,4 +194,8 @@ def ingest_step(
         serial_len=parsed.serial_len,
         issuer_unknown_counts=issuer_counts,
         has_crldp=parsed.has_crldp,
+        crldp_off=parsed.crldp_off,
+        crldp_len=parsed.crldp_len,
+        issuer_name_off=parsed.issuer_off,
+        issuer_name_len=parsed.issuer_len,
     )

@@ -1,0 +1,165 @@
+"""TpuAggregator: end-to-end reduce-state tests.
+
+The parity oracle replays the reference's Store semantics through the
+host-side mock-cache path (the framework's analog of the reference's
+MockRemoteCache harness, /root/reference/storage/filesystemdatabase_test.go),
+then compares drained counts — the "issuer-count parity" gate from
+BASELINE.md."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from ct_mapreduce_tpu.agg import TpuAggregator
+from ct_mapreduce_tpu.core import der as hostder
+from ct_mapreduce_tpu.core.types import ExpDate, Issuer
+
+from certgen import make_cert, spki_of
+
+UTC = datetime.timezone.utc
+NOW = datetime.datetime(2024, 6, 1, tzinfo=UTC)
+
+
+def agg(**kw):
+    kw.setdefault("capacity", 1 << 14)
+    kw.setdefault("batch_size", 64)
+    kw.setdefault("now", NOW)
+    return TpuAggregator(**kw)
+
+
+def leaf(serial, issuer_cn="Agg CA", **kw):
+    kw.setdefault("is_ca", False)
+    kw.setdefault("subject_cn", f"s{serial}.example.com")
+    return make_cert(serial=serial, issuer_cn=issuer_cn, **kw)
+
+
+def test_basic_dedup_and_counts():
+    a = agg()
+    ca = make_cert(issuer_cn="Agg CA")
+    leaves = [leaf(1000 + i) for i in range(10)]
+    entries = [(l, ca) for l in leaves]
+    res = a.ingest(entries)
+    assert res.was_unknown.all()
+    # Same batch again: all known.
+    res2 = a.ingest(entries)
+    assert not res2.was_unknown.any()
+    snap = a.drain()
+    assert snap.total == 10
+    iid = Issuer.from_spki(spki_of(ca)).id()
+    # All leaves share one expiry hour in certgen defaults.
+    ref = hostder.parse_cert(leaves[0])
+    exp_id = ExpDate.from_unix_hour(ref.not_after_unix_hour).id()
+    assert snap.counts == {(iid, exp_id): 10}
+
+
+def test_multi_issuer_counts():
+    a = agg()
+    cas = [make_cert(issuer_cn=f"Multi CA {i}", key_seed=i) for i in range(3)]
+    entries = []
+    for i, ca in enumerate(cas):
+        for s in range(i + 1):
+            entries.append((leaf(5000 + 100 * i + s, issuer_cn=f"Multi CA {i}"), ca))
+    res = a.ingest(entries)
+    assert res.was_unknown.all()
+    snap = a.drain()
+    assert snap.total == 6
+    per_issuer = {}
+    for (iid, _), c in snap.counts.items():
+        per_issuer[iid] = per_issuer.get(iid, 0) + c
+    for i, ca in enumerate(cas):
+        iid = Issuer.from_spki(spki_of(ca)).id()
+        assert per_issuer[iid] == i + 1
+
+
+def test_metadata_accumulation():
+    a = agg()
+    ca = make_cert(issuer_cn="Meta CA")
+    l1 = leaf(7000, issuer_cn="Meta CA",
+              crl_dps=("http://crl.example.com/m.crl",))
+    l2 = leaf(7001, issuer_cn="Meta CA",
+              crl_dps=("http://crl.example.com/m.crl",
+                       "ldap://ignore.me/x",
+                       "https://crl2.example.com/n.crl"))
+    a.ingest([(l1, ca), (l2, ca)])
+    snap = a.drain()
+    iid = Issuer.from_spki(spki_of(ca)).id()
+    assert snap.crls[iid] == {
+        "http://crl.example.com/m.crl",
+        "https://crl2.example.com/n.crl",
+    }
+    ref = hostder.parse_cert(l1)
+    assert snap.dns[iid] == {ref.issuer_dn}
+
+
+def test_filters_counted():
+    a = agg()
+    ca_cert = make_cert(issuer_cn="Filter CA")
+    expired = leaf(
+        8000, issuer_cn="Filter CA",
+        not_before=datetime.datetime(2020, 1, 1, tzinfo=UTC),
+        not_after=datetime.datetime(2021, 1, 1, tzinfo=UTC),
+    )
+    is_ca = make_cert(issuer_cn="Filter CA", serial=8001)  # CA cert
+    good = leaf(8002, issuer_cn="Filter CA")
+    res = a.ingest([(expired, ca_cert), (is_ca, ca_cert), (good, ca_cert)])
+    assert list(res.filtered) == [True, True, False]
+    assert list(res.was_unknown) == [False, False, True]
+    assert a.metrics["filtered_expired"] == 1
+    assert a.metrics["filtered_ca"] == 1
+
+
+def test_host_lane_garbage_and_oversize():
+    a = agg()
+    ca = make_cert(issuer_cn="Host CA")
+    good = leaf(9000, issuer_cn="Host CA")
+    res = a.ingest([(good, ca), (b"\x30\x82junkjunk", ca)])
+    assert list(res.was_unknown) == [True, False]
+    assert a.metrics["parse_errors"] == 1
+    assert a.drain().total == 1
+
+
+def test_overflow_falls_back_to_host_exact():
+    # Tiny table + tiny probe budget: overflowed lanes must still dedup
+    # exactly via the host lane, and counts stay exact.
+    a = agg(capacity=16, max_probes=2, batch_size=16)
+    ca = make_cert(issuer_cn="Ovf CA")
+    leaves = [leaf(20000 + i, issuer_cn="Ovf CA") for i in range(40)]
+    entries = [(l, ca) for l in leaves]
+    r1 = a.ingest(entries)
+    assert r1.was_unknown.all()
+    r2 = a.ingest(entries)
+    assert not r2.was_unknown.any()
+    snap = a.drain()
+    assert snap.total == 40
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    a = agg()
+    ca = make_cert(issuer_cn="Ckpt CA")
+    leaves = [leaf(30000 + i, issuer_cn="Ckpt CA",
+                   crl_dps=("http://crl.example.com/c.crl",)) for i in range(8)]
+    a.ingest([(l, ca) for l in leaves])
+    path = str(tmp_path / "agg.npz")
+    a.save_checkpoint(path)
+
+    b = agg()
+    b.load_checkpoint(path)
+    # Restored state dedups against the original inserts.
+    res = b.ingest([(l, ca) for l in leaves])
+    assert not res.was_unknown.any()
+    assert b.drain().counts == a.drain().counts
+    assert b.drain().crls == a.drain().crls
+
+
+def test_cn_prefix_filter_through_aggregator():
+    a = agg(cn_prefixes=("Keep",))
+    keep_ca = make_cert(issuer_cn="Keep CA", key_seed=1)
+    drop_ca = make_cert(issuer_cn="Drop CA", key_seed=2)
+    res = a.ingest([
+        (leaf(40000, issuer_cn="Keep CA"), keep_ca),
+        (leaf(40001, issuer_cn="Drop CA"), drop_ca),
+    ])
+    assert list(res.was_unknown) == [True, False]
+    assert list(res.filtered) == [False, True]
+    assert a.metrics["filtered_cn"] == 1
