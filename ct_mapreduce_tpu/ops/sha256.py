@@ -63,27 +63,42 @@ def _rotr(x: jax.Array, n: int) -> jax.Array:
 
 
 def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
-    """One SHA-256 compression. state: uint32[..., 8], block: uint32[..., 16]."""
-    w = [block[..., i] for i in range(16)]
-    for t in range(16, 64):
-        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
-        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
-        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+    """One SHA-256 compression. state: uint32[..., 8], block: uint32[..., 16].
 
-    a, b, c, d, e, f, g, h = [state[..., i] for i in range(8)]
-    for t in range(64):
+    Implemented as a ``lax.scan`` over the 64 rounds with the classic
+    rolling 16-word message schedule, so the traced graph is one round
+    — full unrolling made XLA compile times explode on the SPMD paths
+    while buying nothing at runtime (the body is pure VPU work).
+    """
+    w = jnp.moveaxis(block, -1, 0)  # [16, ...]
+    av = jnp.moveaxis(state, -1, 0)  # [8, ...]
+    kt_all = jnp.asarray(_K)
+
+    def round_body(carry, t):
+        av, w = carry
+        a, b, c, d, e, f, g, h = (av[i] for i in range(8))
+        i0 = t % 16
+        wt = jax.lax.dynamic_index_in_dim(w, i0, 0, keepdims=False)
         s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + np.uint32(_K[t]) + w[t]
+        t1 = h + s1 + ch + kt_all[t] + wt
         s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
         t2 = s0 + maj
-        h, g, f = g, f, e
-        e = d + t1
-        d, c, b = c, b, a
-        a = t1 + t2
-    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
-    return state + out
+        av = jnp.stack([t1 + t2, a, b, c, d + t1, e, f, g])
+        # Rolling schedule: prepare W[t+16] in place of W[t].
+        w1 = jax.lax.dynamic_index_in_dim(w, (t + 1) % 16, 0, keepdims=False)
+        w9 = jax.lax.dynamic_index_in_dim(w, (t + 9) % 16, 0, keepdims=False)
+        w14 = jax.lax.dynamic_index_in_dim(w, (t + 14) % 16, 0, keepdims=False)
+        sg0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> np.uint32(3))
+        sg1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> np.uint32(10))
+        w = jax.lax.dynamic_update_index_in_dim(w, wt + sg0 + w9 + sg1, i0, 0)
+        return (av, w), None
+
+    (av, _), _ = jax.lax.scan(
+        round_body, (av, w), jnp.arange(64, dtype=jnp.int32)
+    )
+    return state + jnp.moveaxis(av, 0, -1)
 
 
 @functools.partial(jax.jit, static_argnames=())

@@ -6,13 +6,21 @@ tests are the gated tier and the virtual mesh is the default)."""
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The ambient environment may have already imported jax (sitecustomize
+# registering a TPU plugin), so setting JAX_PLATFORMS here is too late;
+# jax.config wins either way. The real-TPU tier opts back in via
+# CT_TPU_TESTS=1.
+if os.environ.get("CT_TPU_TESTS", "") == "":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,120 @@
+"""Sharded dedup over a virtual 8-device mesh (SURVEY.md §4 tier 3
+analog: multi-chip behavior exercised without hardware, like the
+reference gating real-Redis tests behind RedisHost)."""
+
+import datetime
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from ct_mapreduce_tpu.agg.sharded import ShardedDedup
+from ct_mapreduce_tpu.core import packing
+
+from certgen import make_cert
+
+UTC = datetime.timezone.utc
+NOW_HOUR = int(datetime.datetime(2024, 6, 1, tzinfo=UTC).timestamp()) // 3600
+
+
+def mesh8():
+    devs = np.array(jax.devices()[:8])
+    assert devs.size == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devs, ("shard",))
+
+
+def packed_batch(entries, batch_size):
+    b = packing.pack_entries(entries, batch_size=batch_size)
+    return b.data, b.length, b.issuer_idx, b.valid
+
+
+@pytest.fixture(scope="module")
+def certs():
+    return [
+        make_cert(serial=50000 + i, is_ca=False, subject_cn=f"sh{i}.example.com")
+        for i in range(24)
+    ]
+
+
+def test_sharded_dedup_matches_oracle(certs):
+    sd = ShardedDedup(mesh8(), capacity=1 << 13)
+    entries = [(c, i % 3) for i, c in enumerate(certs)]
+    data, length, issuer_idx, valid = packed_batch(entries, 32)
+
+    out = sd.step(data, length, issuer_idx, valid, NOW_HOUR)
+    wu = np.asarray(out.was_unknown)
+    hl = np.asarray(out.host_lane)
+    assert not hl.any()
+    assert wu[: len(entries)].all()
+    assert not wu[len(entries):].any()
+    assert sd.total_count() == len(entries)
+
+    # Replay: everything known, count unchanged.
+    out2 = sd.step(data, length, issuer_idx, valid, NOW_HOUR)
+    assert not np.asarray(out2.was_unknown).any()
+    assert not np.asarray(out2.host_lane).any()
+    assert sd.total_count() == len(entries)
+
+
+def test_sharded_within_batch_duplicates(certs):
+    sd = ShardedDedup(mesh8(), capacity=1 << 13)
+    # Each cert appears twice in the same batch, on different lanes (and
+    # usually different source devices): exactly one lane wins each.
+    entries = [(c, 0) for c in certs[:12]] + [(c, 0) for c in certs[:12]]
+    data, length, issuer_idx, valid = packed_batch(entries, 24)
+    out = sd.step(data, length, issuer_idx, valid, NOW_HOUR)
+    wu = np.asarray(out.was_unknown)
+    assert not np.asarray(out.host_lane).any()
+    assert wu.sum() == 12
+    for i in range(12):
+        assert wu[i] != wu[12 + i] or (wu[i] and not wu[12 + i])
+    assert sd.total_count() == 12
+
+
+def test_sharded_issuer_counts(certs):
+    sd = ShardedDedup(mesh8(), capacity=1 << 13)
+    entries = [(c, i % 4) for i, c in enumerate(certs)]
+    data, length, issuer_idx, valid = packed_batch(entries, 24)
+    out = sd.step(data, length, issuer_idx, valid, NOW_HOUR)
+    counts = np.asarray(out.issuer_unknown_counts)
+    assert counts[:4].tolist() == [6, 6, 6, 6]
+    assert counts[4:].sum() == 0
+
+
+def test_sharded_drain_meta(certs):
+    sd = ShardedDedup(mesh8(), capacity=1 << 13)
+    entries = [(c, 5) for c in certs[:8]]
+    data, length, issuer_idx, valid = packed_batch(entries, 8)
+    sd.step(data, length, issuer_idx, valid, NOW_HOUR)
+    keys, meta = sd.drain_np()
+    assert keys.shape[0] == 8
+    for m in meta:
+        idx, eh = packing.unpack_meta(int(m))
+        assert idx == 5
+        assert eh > NOW_HOUR
+
+
+def test_sharded_parity_with_single_chip(certs):
+    from ct_mapreduce_tpu.ops import hashtable, pipeline
+
+    sd = ShardedDedup(mesh8(), capacity=1 << 13)
+    entries = [(c, i % 2) for i, c in enumerate(certs)]
+    data, length, issuer_idx, valid = packed_batch(entries, 32)
+    out_sh = sd.step(data, length, issuer_idx, valid, NOW_HOUR)
+
+    table = hashtable.make_table(1 << 13)
+    no_pfx = (np.zeros((0, 32), np.uint8), np.zeros((0,), np.int32))
+    table, out_1c = pipeline.ingest_step(
+        table, data, length, issuer_idx, valid,
+        np.int32(NOW_HOUR), np.int32(packing.DEFAULT_BASE_HOUR),
+        no_pfx[0], no_pfx[1],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_sh.was_unknown), np.asarray(out_1c.was_unknown)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_sh.issuer_unknown_counts),
+        np.asarray(out_1c.issuer_unknown_counts),
+    )
+    assert sd.total_count() == int(table.count)
