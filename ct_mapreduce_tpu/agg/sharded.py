@@ -40,7 +40,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ct_mapreduce_tpu.core import packing
-from ct_mapreduce_tpu.ops import der_kernel, hashtable, pipeline
+from ct_mapreduce_tpu.ops import hashtable, pipeline
 
 AXIS = "shard"
 
@@ -111,53 +111,22 @@ def _local_step(
     *, n_shards: int, cap: int, num_issuers: int, max_probes: int,
 ):
     """Per-device body, run under shard_map over the 1-D mesh."""
-    b_loc = data.shape[0]
-
     # --- stage 1: local parse / filter / fingerprint (pure DP) ----------
-    parsed = der_kernel.parse_certs(data, length)
-    ok = parsed.ok & valid
-    serials, fits_serial = der_kernel.gather_serials(
-        data, parsed.serial_off, parsed.serial_len, packing.MAX_SERIAL_BYTES
+    lanes = pipeline.local_lanes(
+        data, length, issuer_idx, valid, now_hour, base_hour,
+        cn_prefixes, cn_prefix_lens, num_issuers,
     )
-    f_ca = ok & parsed.is_ca
-    f_expired = ok & ~f_ca & (parsed.not_after_hour < now_hour)
-    p = cn_prefixes.shape[0]
-    if p > 0:
-        cn_hit = pipeline._cn_prefix_match(
-            data, parsed.issuer_cn_off, parsed.issuer_cn_len,
-            cn_prefixes, cn_prefix_lens,
-        )
-        f_cn = ok & ~f_ca & ~f_expired & ~cn_hit
-    else:
-        f_cn = jnp.zeros_like(ok)
-    passed = ok & ~f_ca & ~f_expired & ~f_cn
-
-    hour_off = parsed.not_after_hour - base_hour
-    meta_ok = (hour_off >= 0) & (hour_off < packing.META_HOUR_SPAN)
-    idx_ok = (issuer_idx >= 0) & (issuer_idx < num_issuers)
-    device_exact = fits_serial & meta_ok & idx_ok
-    insertable = passed & device_exact
-
-    fps = pipeline.fingerprints(
-        issuer_idx, parsed.not_after_hour, serials, parsed.serial_len
-    )
-    meta = (
-        (issuer_idx.astype(jnp.uint32) << packing.META_HOUR_BITS)
-        | jnp.clip(hour_off, 0, packing.META_HOUR_SPAN - 1).astype(jnp.uint32)
-    )
+    parsed = lanes.parsed
 
     # --- stage 2: dispatch to home shards -------------------------------
-    dest = _shard_of(fps, n_shards)
-    lane_id = jnp.arange(b_loc, dtype=jnp.uint32)
-    payload = jnp.concatenate(
-        [fps, meta[:, None], lane_id[:, None],
-         issuer_idx.astype(jnp.uint32)[:, None]],
-        axis=1,
-    )  # [B_loc, 7]
+    # Payload is 5 uint32 words: 4 fingerprint words + the meta word
+    # (which already encodes issuer_idx in its high bits).
+    dest = _shard_of(lanes.fps, n_shards)
+    payload = jnp.concatenate([lanes.fps, lanes.meta[:, None]], axis=1)
     send, send_valid, slot_of_lane, _ = _dispatch(
-        payload, dest, insertable, n_shards, cap
+        payload, dest, lanes.insertable, n_shards, cap
     )
-    dispatch_dropped = insertable & (slot_of_lane < 0)
+    dispatch_dropped = lanes.insertable & (slot_of_lane < 0)
 
     recv = jax.lax.all_to_all(send, AXIS, split_axis=0, concat_axis=0, tiled=True)
     recv_valid = jax.lax.all_to_all(
@@ -165,7 +134,7 @@ def _local_step(
     )
 
     # --- stage 3: local insert ------------------------------------------
-    rk = recv.reshape(n_shards * cap, 7)
+    rk = recv.reshape(n_shards * cap, 5)
     rvalid = recv_valid.reshape(n_shards * cap)
     rkeys, rmeta = rk[:, :4], rk[:, 4]
     state = hashtable.TableState(table_keys, table_meta, table_count)
@@ -174,28 +143,28 @@ def _local_step(
     )
 
     # Per-issuer counts of fresh inserts, reduced across the mesh.
-    r_issuer = rk[:, 6].astype(jnp.int32)
+    r_issuer = (rmeta >> packing.META_HOUR_BITS).astype(jnp.int32)
     local_counts = jnp.zeros((num_issuers,), jnp.int32).at[r_issuer].add(
         r_unknown.astype(jnp.int32), mode="drop"
     )
     issuer_counts = jax.lax.psum(local_counts, AXIS)
 
-    # --- stage 4: route results home ------------------------------------
-    back = jnp.stack(
-        [r_unknown.astype(jnp.uint32), r_overflow.astype(jnp.uint32)], axis=1
-    ).reshape(n_shards, cap, 2)
+    # --- stage 4: route results home (1 word: unknown | overflow<<1) ----
+    back = (
+        r_unknown.astype(jnp.uint32) | (r_overflow.astype(jnp.uint32) << 1)
+    ).reshape(n_shards, cap, 1)
     back = jax.lax.all_to_all(back, AXIS, split_axis=0, concat_axis=0, tiled=True)
-    back = back.reshape(n_shards * cap, 2)
+    back = back.reshape(n_shards * cap)
 
     flat_slot = jnp.where(slot_of_lane >= 0, slot_of_lane, 0)
     lane_res = back[flat_slot]
     sent = slot_of_lane >= 0
-    was_unknown = sent & (lane_res[:, 0] != 0)
-    probe_overflow = sent & (lane_res[:, 1] != 0)
+    was_unknown = sent & ((lane_res & 1) != 0)
+    probe_overflow = sent & ((lane_res & 2) != 0)
 
     host_lane = (
         (valid & ~parsed.ok)
-        | (passed & ~device_exact)
+        | (lanes.passed & ~lanes.device_exact)
         | dispatch_dropped
         | probe_overflow
     )
@@ -205,11 +174,11 @@ def _local_step(
         ShardedStepOut(
             was_unknown=was_unknown,
             host_lane=host_lane,
-            filtered_ca=f_ca,
-            filtered_expired=f_expired,
-            filtered_cn=f_cn,
+            filtered_ca=lanes.filtered_ca,
+            filtered_expired=lanes.filtered_expired,
+            filtered_cn=lanes.filtered_cn,
             not_after_hour=parsed.not_after_hour,
-            serials=serials,
+            serials=lanes.serials,
             serial_len=parsed.serial_len,
             issuer_unknown_counts=issuer_counts,
             has_crldp=parsed.has_crldp,
@@ -238,12 +207,15 @@ class ShardedDedup:
         max_probes: int = 32,
         dispatch_factor: float = 2.0,
     ) -> None:
-        if capacity & (capacity - 1):
-            raise ValueError("capacity must be a power of two")
         self.mesh = mesh
         self.n_shards = mesh.devices.size
         if capacity % self.n_shards:
             raise ValueError("capacity must divide evenly across the mesh")
+        # The triangular-probe mask operates on each LOCAL shard inside
+        # shard_map, so per-shard size is what must be a power of two.
+        per_shard = capacity // self.n_shards
+        if per_shard & (per_shard - 1):
+            raise ValueError("per-shard capacity must be a power of two")
         self.capacity = capacity
         self.base_hour = base_hour
         self.num_issuers = num_issuers
@@ -339,7 +311,6 @@ class ShardedDedup:
         return int(jnp.sum(self.count))
 
     def drain_np(self) -> tuple[np.ndarray, np.ndarray]:
-        keys = np.asarray(self.keys)
-        meta = np.asarray(self.meta)
-        occ = keys.any(axis=-1)
-        return keys[occ], meta[occ]
+        return hashtable.drain_np(
+            hashtable.TableState(self.keys, self.meta, self.count)
+        )

@@ -109,12 +109,81 @@ def _cn_prefix_match(
     return jnp.any(full & long_enough, axis=-1)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_issuers", "max_probes"),
-    donate_argnums=(0,),
-)
-def ingest_step(
+class LocalLanes(NamedTuple):
+    """Per-lane results of the communication-free ingest stages."""
+
+    parsed: "der_kernel.ParsedCerts"
+    serials: jax.Array  # uint8[B, MAX_SERIAL_BYTES]
+    filtered_ca: jax.Array
+    filtered_expired: jax.Array
+    filtered_cn: jax.Array
+    passed: jax.Array  # survived all filters
+    device_exact: jax.Array  # serial/meta/issuer fit the device schema
+    insertable: jax.Array  # passed & device_exact
+    fps: jax.Array  # uint32[B, 4] dedup fingerprints
+    meta: jax.Array  # uint32[B] packed (issuer_idx, exp-hour offset)
+
+
+def local_lanes(
+    data: jax.Array,
+    length: jax.Array,
+    issuer_idx: jax.Array,
+    valid: jax.Array,
+    now_hour: jax.Array,
+    base_hour: jax.Array,
+    cn_prefixes: jax.Array,
+    cn_prefix_lens: jax.Array,
+    num_issuers: int,
+) -> LocalLanes:
+    """Parse → filter → fingerprint, shared by the single-chip step and
+    the per-device body of the mesh-sharded step (no communication)."""
+    parsed = der_kernel.parse_certs(data, length)
+    ok = parsed.ok & valid
+
+    serials, fits = der_kernel.gather_serials(
+        data, parsed.serial_off, parsed.serial_len, packing.MAX_SERIAL_BYTES
+    )
+
+    # Filters, in the reference's precedence order
+    # (/root/reference/cmd/ct-fetch/ct-fetch.go:44-70).
+    f_ca = ok & parsed.is_ca
+    f_expired = ok & ~f_ca & (parsed.not_after_hour < now_hour)
+    if cn_prefixes.shape[0] > 0:
+        cn_hit = _cn_prefix_match(
+            data, parsed.issuer_cn_off, parsed.issuer_cn_len,
+            cn_prefixes, cn_prefix_lens,
+        )
+        f_cn = ok & ~f_ca & ~f_expired & ~cn_hit
+    else:
+        f_cn = jnp.zeros_like(ok)
+    passed = ok & ~f_ca & ~f_expired & ~f_cn
+
+    # Device-exactness gate: lanes outside the packed schema go host-side.
+    hour_off = parsed.not_after_hour - base_hour
+    meta_ok = (hour_off >= 0) & (hour_off < packing.META_HOUR_SPAN)
+    idx_ok = (issuer_idx >= 0) & (issuer_idx < num_issuers)
+    device_exact = fits & meta_ok & idx_ok
+
+    fps = fingerprints(issuer_idx, parsed.not_after_hour, serials, parsed.serial_len)
+    meta = (
+        (issuer_idx.astype(jnp.uint32) << packing.META_HOUR_BITS)
+        | jnp.clip(hour_off, 0, packing.META_HOUR_SPAN - 1).astype(jnp.uint32)
+    )
+    return LocalLanes(
+        parsed=parsed,
+        serials=serials,
+        filtered_ca=f_ca,
+        filtered_expired=f_expired,
+        filtered_cn=f_cn,
+        passed=passed,
+        device_exact=device_exact,
+        insertable=passed & device_exact,
+        fps=fps,
+        meta=meta,
+    )
+
+
+def ingest_core(
     table: hashtable.TableState,
     data: jax.Array,
     length: jax.Array,
@@ -138,45 +207,19 @@ def ingest_step(
       cn_prefixes/cn_prefix_lens: uint8[P, K]/int32[P]; P == 0 disables
         the CN filter (shape is static ⇒ config changes recompile once).
     """
-    parsed = der_kernel.parse_certs(data, length)
-    ok = parsed.ok & valid
-
-    serials, fits = der_kernel.gather_serials(
-        data, parsed.serial_off, parsed.serial_len, packing.MAX_SERIAL_BYTES
+    lanes = local_lanes(
+        data, length, issuer_idx, valid, now_hour, base_hour,
+        cn_prefixes, cn_prefix_lens, num_issuers,
     )
+    parsed = lanes.parsed
 
-    # --- filters, in the reference's precedence order -------------------
-    f_ca = ok & parsed.is_ca
-    f_expired = ok & ~f_ca & (parsed.not_after_hour < now_hour)
-    p = cn_prefixes.shape[0]
-    if p > 0:
-        cn_hit = _cn_prefix_match(
-            data, parsed.issuer_cn_off, parsed.issuer_cn_len,
-            cn_prefixes, cn_prefix_lens,
-        )
-        f_cn = ok & ~f_ca & ~f_expired & ~cn_hit
-    else:
-        f_cn = jnp.zeros_like(ok)
-    passed = ok & ~f_ca & ~f_expired & ~f_cn
-
-    # --- device-exactness gate ------------------------------------------
-    hour_off = parsed.not_after_hour - base_hour
-    meta_ok = (hour_off >= 0) & (hour_off < packing.META_HOUR_SPAN)
-    idx_ok = (issuer_idx >= 0) & (issuer_idx < num_issuers)
-    device_exact = fits & meta_ok & idx_ok
-    insertable = passed & device_exact
-
-    # --- fingerprint + dedup insert -------------------------------------
-    fps = fingerprints(issuer_idx, parsed.not_after_hour, serials, parsed.serial_len)
-    meta = (
-        (issuer_idx.astype(jnp.uint32) << packing.META_HOUR_BITS)
-        | (jnp.clip(hour_off, 0, packing.META_HOUR_SPAN - 1).astype(jnp.uint32))
-    )
     table, was_unknown, overflowed = hashtable.insert(
-        table, fps, meta, insertable, max_probes=max_probes
+        table, lanes.fps, lanes.meta, lanes.insertable, max_probes=max_probes
     )
 
-    host_lane = (valid & ~parsed.ok) | (passed & ~device_exact) | overflowed
+    host_lane = (
+        (valid & ~parsed.ok) | (lanes.passed & ~lanes.device_exact) | overflowed
+    )
 
     issuer_counts = jnp.zeros((num_issuers,), jnp.int32).at[issuer_idx].add(
         was_unknown.astype(jnp.int32), mode="drop"
@@ -185,12 +228,12 @@ def ingest_step(
     return table, StepOut(
         was_unknown=was_unknown,
         host_lane=host_lane,
-        filtered_ca=f_ca,
-        filtered_expired=f_expired,
-        filtered_cn=f_cn,
-        stored=insertable & ~overflowed,
+        filtered_ca=lanes.filtered_ca,
+        filtered_expired=lanes.filtered_expired,
+        filtered_cn=lanes.filtered_cn,
+        stored=lanes.insertable & ~overflowed,
         not_after_hour=parsed.not_after_hour,
-        serials=serials,
+        serials=lanes.serials,
         serial_len=parsed.serial_len,
         issuer_unknown_counts=issuer_counts,
         has_crldp=parsed.has_crldp,
@@ -199,3 +242,11 @@ def ingest_step(
         issuer_name_off=parsed.issuer_off,
         issuer_name_len=parsed.issuer_len,
     )
+
+
+# The production entry point: donated table state, cached per shape.
+ingest_step = functools.partial(
+    jax.jit,
+    static_argnames=("num_issuers", "max_probes"),
+    donate_argnums=(0,),
+)(ingest_core)

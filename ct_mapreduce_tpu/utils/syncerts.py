@@ -1,0 +1,163 @@
+"""Synthetic certificate streams for benchmarks and dry runs.
+
+The reference generates fixtures on the fly with Go's stdlib x509
+(``makeCert``, /root/reference/storage/issuermetadata_test.go:62-98).
+Signing a fresh key pair per certificate is far too slow for
+millions-of-entries benchmark replays, so this module builds ONE real
+signed template per issuer (via ``cryptography``) and then stamps out
+arbitrarily many structurally-valid variants by patching the serial
+INTEGER bytes in place — the parse/filter/fingerprint/dedup pipeline
+never verifies signatures, exactly like the reference's ingest path
+(/root/reference/cmd/ct-fetch/ct-fetch.go:198-226 parses, never
+verifies chains).
+
+Serials are fixed-length with a constant positive first byte, so DER
+lengths never change and every variant remains canonical DER.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+
+import numpy as np
+
+from ct_mapreduce_tpu.core import der as hostder
+
+SERIAL_LEN = 16  # bytes of DER INTEGER content in the template
+
+
+@dataclass
+class CertTemplate:
+    """A signed leaf template whose serial window can be restamped."""
+
+    leaf_der: bytes
+    issuer_der: bytes
+    serial_off: int  # offset of the serial content bytes in leaf_der
+    serial_len: int
+
+
+def _build_pair(
+    issuer_cn: str,
+    not_after: datetime.datetime,
+    crl_dp: str | None,
+) -> tuple[bytes, bytes]:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    issuer_name = x509.Name(
+        [
+            x509.NameAttribute(NameOID.COUNTRY_NAME, "US"),
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, "Bench Org"),
+            x509.NameAttribute(NameOID.COMMON_NAME, issuer_cn),
+        ]
+    )
+    now = datetime.datetime(2024, 1, 1, tzinfo=datetime.timezone.utc)
+
+    issuer_builder = (
+        x509.CertificateBuilder()
+        .subject_name(issuer_name)
+        .issuer_name(issuer_name)
+        .public_key(key.public_key())
+        .serial_number(1)
+        .not_valid_before(now)
+        .not_valid_after(not_after)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+    )
+    issuer_der = issuer_builder.sign(key, hashes.SHA256()).public_bytes(
+        serialization.Encoding.DER
+    )
+
+    # Template serial: SERIAL_LEN bytes, first byte 0x4D (positive, no
+    # leading-zero trimming) so every restamp keeps identical DER shape.
+    serial_int = int.from_bytes(b"\x4d" + b"\x00" * (SERIAL_LEN - 1), "big")
+    leaf_builder = (
+        x509.CertificateBuilder()
+        .subject_name(
+            x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "bench.example.com")])
+        )
+        .issuer_name(issuer_name)
+        .public_key(key.public_key())
+        .serial_number(serial_int)
+        .not_valid_before(now)
+        .not_valid_after(not_after)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+    )
+    if crl_dp:
+        leaf_builder = leaf_builder.add_extension(
+            x509.CRLDistributionPoints(
+                [
+                    x509.DistributionPoint(
+                        full_name=[x509.UniformResourceIdentifier(crl_dp)],
+                        relative_name=None,
+                        reasons=None,
+                        crl_issuer=None,
+                    )
+                ]
+            ),
+            critical=False,
+        )
+    leaf_der = leaf_builder.sign(key, hashes.SHA256()).public_bytes(
+        serialization.Encoding.DER
+    )
+    return leaf_der, issuer_der
+
+
+def make_template(
+    issuer_cn: str = "Bench Issuer CA",
+    not_after: datetime.datetime | None = None,
+    crl_dp: str | None = "http://crl.bench.example/latest.crl",
+) -> CertTemplate:
+    not_after = not_after or datetime.datetime(
+        2031, 6, 15, tzinfo=datetime.timezone.utc
+    )
+    leaf_der, issuer_der = _build_pair(issuer_cn, not_after, crl_dp)
+    fields = hostder.parse_cert(leaf_der)
+    assert fields.serial_len == SERIAL_LEN, fields.serial_len
+    return CertTemplate(
+        leaf_der=leaf_der,
+        issuer_der=issuer_der,
+        serial_off=fields.serial_off,
+        serial_len=fields.serial_len,
+    )
+
+
+def stamp_serial(template: CertTemplate, counter: int) -> bytes:
+    """One DER variant: template with serial content = 0x4D ‖ counter."""
+    body = counter.to_bytes(SERIAL_LEN - 1, "big")
+    der = bytearray(template.leaf_der)
+    der[template.serial_off + 1 : template.serial_off + SERIAL_LEN] = body
+    return bytes(der)
+
+
+def stamp_batch_array(
+    template: CertTemplate,
+    start: int,
+    batch: int,
+    pad_len: int,
+    rng_mix: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized restamp: uint8[batch, pad_len] data + int32 lengths.
+
+    Serials are ``start..start+batch`` mixed with ``rng_mix`` so
+    successive epochs produce disjoint serial spaces. This is the fast
+    path for benchmark replay — no per-entry Python loop.
+    """
+    base = np.frombuffer(template.leaf_der, dtype=np.uint8)
+    if base.size > pad_len:
+        raise ValueError(f"template ({base.size}B) exceeds pad length {pad_len}")
+    data = np.zeros((batch, pad_len), dtype=np.uint8)
+    data[:, : base.size] = base[None, :]
+    counters = (np.arange(start, start + batch, dtype=np.uint64)
+                ^ np.uint64(rng_mix))
+    # big-endian expansion of the counter into the low 8 serial bytes
+    off = template.serial_off
+    for i in range(8):
+        data[:, off + SERIAL_LEN - 1 - i] = (
+            (counters >> np.uint64(8 * i)) & np.uint64(0xFF)
+        ).astype(np.uint8)
+    lengths = np.full((batch,), base.size, dtype=np.int32)
+    return data, lengths
