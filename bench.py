@@ -101,7 +101,6 @@ def main() -> int:
 
     # Warmup sweep: compiles and inserts epoch-0 serials.
     t0 = time.perf_counter()
-    fresh = host = 0
     for data, lengths in dev_batches:
         table, f, h = bench_step(table, data, lengths, jnp.uint32(0))
     f.block_until_ready()

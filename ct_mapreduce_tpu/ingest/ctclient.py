@@ -1,0 +1,159 @@
+"""CT log v1 HTTP API client.
+
+Mirrors the reference's use of certificate-transparency-go's
+``client.New`` + ``GetSTH`` + ``GetRawEntries``
+(/root/reference/cmd/ct-fetch/ct-fetch.go:249-274,416-424):
+
+- entries are fetched in ranges of up to 1000 per request
+  (ct-fetch.go:417); the server may return fewer — callers advance by
+  what they got;
+- HTTP 429 triggers a jittered exponential backoff of 500 ms – 5 min
+  and a retry of the same range (ct-fetch.go:409-437), honoring
+  Retry-After when present;
+- other HTTP errors raise and are handled by the caller's
+  log-level error policy.
+
+The transport is injectable — ``transport(url) -> (status, headers,
+body)`` — so tests and the zero-egress benchmark environment can serve
+synthetic logs without sockets; the default uses urllib.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ct_mapreduce_tpu.telemetry.metrics import incr_counter, measure
+from ct_mapreduce_tpu.utils.backoff import JitteredBackoff
+
+BATCH_SIZE = 1000  # entries per get-entries request (ct-fetch.go:417)
+
+Transport = Callable[[str], tuple[int, dict, bytes]]
+
+
+def short_url(url: str) -> str:
+    """Log URL without scheme or trailing slash — the reference's
+    ShortURL identity (storage/types.go checkpoint keying)."""
+    for prefix in ("https://", "http://"):
+        if url.startswith(prefix):
+            url = url[len(prefix) :]
+            break
+    return url.rstrip("/")
+
+
+def _urllib_transport(url: str) -> tuple[int, dict, bytes]:
+    req = urllib.request.Request(
+        url, headers={"User-Agent": "ct-mapreduce-tpu/0.1"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers or {}), err.read()
+
+
+@dataclass
+class SignedTreeHead:
+    tree_size: int
+    timestamp_ms: int
+    sha256_root_hash: str = ""
+    tree_head_signature: str = ""
+
+
+@dataclass
+class RawEntry:
+    index: int
+    leaf_input: str  # base64, as served
+    extra_data: str
+
+
+class CTClientError(RuntimeError):
+    def __init__(self, url: str, status: int, body: bytes):
+        super().__init__(f"HTTP {status} from {url}: {body[:200]!r}")
+        self.status = status
+
+
+class CTLogClient:
+    """One CT log endpoint, normalized to ``https://`` when no scheme
+    is given (the reference's config takes full URLs)."""
+
+    def __init__(
+        self,
+        log_url: str,
+        transport: Optional[Transport] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        max_retries: int = 100,
+    ):
+        if "://" not in log_url:
+            log_url = "https://" + log_url
+        self.log_url = log_url.rstrip("/")
+        self.short_url = short_url(log_url)
+        self.transport = transport or _urllib_transport
+        self.sleep = sleep
+        self.max_retries = max_retries
+
+    # -- plumbing --------------------------------------------------------
+    def _get_json(self, path: str) -> dict:
+        url = f"{self.log_url}/ct/v1/{path}"
+        backoff = JitteredBackoff(min_s=0.5, max_s=300.0)
+        for _ in range(self.max_retries):
+            status, headers, body = self.transport(url)
+            if status == 200:
+                return json.loads(body)
+            if status == 429:
+                # ct-fetch.go:426-437: jittered 500ms-5min, honor
+                # Retry-After seconds when the server sends one.
+                incr_counter("LogWorker", self.short_url, "429")
+                retry_after = headers.get("Retry-After")
+                if retry_after:
+                    try:
+                        # Clamp to the 500ms-5min window — a hostile value
+                        # must not stall the downloader arbitrarily long.
+                        delay = min(max(float(retry_after), 0.0), backoff.max_s)
+                    except ValueError:
+                        delay = backoff.duration()
+                else:
+                    delay = backoff.duration()
+                self.sleep(delay)
+                continue
+            raise CTClientError(url, status, body)
+        raise CTClientError(url, 429, b"retry budget exhausted")
+
+    # -- API -------------------------------------------------------------
+    def get_sth(self) -> SignedTreeHead:
+        with measure("LogWorker", self.short_url, "getSTH"):
+            obj = self._get_json("get-sth")
+        return SignedTreeHead(
+            tree_size=int(obj["tree_size"]),
+            timestamp_ms=int(obj.get("timestamp", 0)),
+            sha256_root_hash=obj.get("sha256_root_hash", ""),
+            tree_head_signature=obj.get("tree_head_signature", ""),
+        )
+
+    def get_raw_entries(self, start: int, end: int) -> list[RawEntry]:
+        """Entries ``[start, end]`` inclusive, like ct-go's
+        GetRawEntries; the server may truncate the range."""
+        if end < start:
+            return []
+        end = min(end, start + BATCH_SIZE - 1)
+        with measure("LogWorker", self.short_url, "getRawEntries"):
+            obj = self._get_json(f"get-entries?start={start}&end={end}")
+        entries = obj.get("entries", [])
+        return [
+            RawEntry(
+                index=start + i,
+                leaf_input=e["leaf_input"],
+                extra_data=e.get("extra_data", ""),
+            )
+            for i, e in enumerate(entries)
+        ]
+
+    def get_entry_and_proof(self, index: int, tree_size: int) -> dict:
+        """ct-getcert's fetch path (get-entry-and-proof)."""
+        return self._get_json(
+            f"get-entry-and-proof?leaf_index={index}&tree_size={tree_size}"
+        )

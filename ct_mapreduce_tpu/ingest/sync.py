@@ -1,0 +1,403 @@
+"""The ingest runtime: per-log downloaders feeding store workers.
+
+Rebuilds the reference's ``LogSyncEngine`` / ``LogWorker`` /
+``insertCTWorker`` machinery (/root/reference/cmd/ct-fetch/
+ct-fetch.go:83-488) on Python threads and a bounded queue:
+
+- one downloader thread per log URL (ct-fetch.go:527-565), fetching
+  ranges of 1000 and decoding leaves (ct-fetch.go:398-488);
+- a shared bounded entry queue, capacity 16,384 (ct-fetch.go:132);
+- ``num_threads`` store workers draining the queue into a sink
+  (ct-fetch.go:140-145,180-246);
+- a save ticker checkpointing each log's cursor every ``save_period``
+  and at exit (ct-fetch.go:307-312,360-392,472-473);
+- graceful stop: signal → downloaders drain → queue drains → workers
+  join → final state save (ct-fetch.go:610-620).
+
+Two sinks cover the reference path and the TPU path:
+
+- :class:`DatabaseSink` — per-entry host store through
+  ``FilesystemDatabase`` with the ``certIsFilteredOut`` semantics
+  (ct-fetch.go:44-70): reference-parity mode.
+- :class:`AggregatorSink` — packs entries into device batches for
+  :class:`~ct_mapreduce_tpu.agg.aggregator.TpuAggregator`: the
+  TPU-native mode, where filtering happens on device.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Optional, Protocol
+
+from ct_mapreduce_tpu.core import der as hostder
+from ct_mapreduce_tpu.core.types import CertificateLog
+from ct_mapreduce_tpu.ingest.ctclient import BATCH_SIZE, CTLogClient
+from ct_mapreduce_tpu.ingest.leaf import DecodedEntry, LeafDecodeError, decode_json_entry
+from ct_mapreduce_tpu.telemetry import metrics
+
+ENTRY_QUEUE_CAPACITY = 16384  # ct-fetch.go:132
+
+
+class EntrySink(Protocol):
+    def store(self, entry: DecodedEntry, log_url: str) -> None: ...
+    def flush(self) -> None: ...
+
+
+class DatabaseSink:
+    """Per-entry host store: parse → filter → ``database.store``.
+
+    The filter reproduces ``certIsFilteredOut`` (ct-fetch.go:44-70):
+    CA certs out, expired out unless ``log_expired_entries``, and when
+    CN prefixes are configured, issuers whose CN matches none are out.
+    """
+
+    def __init__(
+        self,
+        database,
+        cn_filters: tuple[str, ...] = (),
+        log_expired_entries: bool = False,
+        now: Optional[datetime] = None,
+    ):
+        self.database = database
+        self.cn_filters = tuple(cn_filters)
+        self.log_expired_entries = log_expired_entries
+        self._fixed_now = now
+
+    def _filtered_out(self, fields) -> bool:
+        if fields.is_ca:
+            metrics.incr_counter("ct-fetch", "certIsFilteredOut", "CA")
+            return True
+        now = self._fixed_now or datetime.now(timezone.utc)
+        if not self.log_expired_entries and fields.not_after < now:
+            metrics.incr_counter("ct-fetch", "certIsFilteredOut", "expired")
+            return True
+        if self.cn_filters and not any(
+            fields.issuer_cn.startswith(p) for p in self.cn_filters
+        ):
+            metrics.incr_counter("ct-fetch", "certIsFilteredOut", "cn")
+            return True
+        return False
+
+    def store(self, entry: DecodedEntry, log_url: str) -> None:
+        try:
+            with metrics.measure("ct-fetch", "parseCertificate"):
+                fields = hostder.parse_cert(entry.cert_der)
+        except Exception:
+            # Tolerate-and-skip, like ct-fetch.go:206-215.
+            metrics.incr_counter("ct-fetch", "parseCertificateError")
+            return
+        if self._filtered_out(fields):
+            return
+        if entry.issuer_der is None:
+            metrics.incr_counter("ct-fetch", "noChainError")
+            return
+        with metrics.measure("ct-fetch", "storeCertificate"):
+            self.database.store(
+                entry.cert_der, entry.issuer_der, log_url, entry.index
+            )
+        metrics.incr_counter("ct-fetch", "insertCertificate")
+
+    def flush(self) -> None:
+        pass
+
+
+class AggregatorSink:
+    """Batches entries for the device pipeline.
+
+    Entries accumulate host-side until ``flush_size`` and are then
+    dispatched in one ``TpuAggregator.ingest`` call (parse, filter,
+    fingerprint, dedup and counts all happen on device). A lock
+    serializes dispatch — the aggregator's table state is donated
+    between steps, so one device stream exists regardless of how many
+    store workers feed it.
+    """
+
+    def __init__(self, aggregator, flush_size: int = 4096):
+        self.aggregator = aggregator
+        self.flush_size = flush_size
+        self._pending: list[tuple[bytes, bytes]] = []
+        self._lock = threading.Lock()
+        self._dispatch_lock = threading.Lock()  # one device stream
+        self.entries_in = 0
+
+    def store(self, entry: DecodedEntry, log_url: str) -> None:
+        if entry.issuer_der is None:
+            metrics.incr_counter("ct-fetch", "noChainError")
+            return
+        batch: Optional[list[tuple[bytes, bytes]]] = None
+        with self._lock:
+            self._pending.append((entry.cert_der, entry.issuer_der))
+            self.entries_in += 1
+            if len(self._pending) >= self.flush_size:
+                batch, self._pending = self._pending, []
+        if batch:
+            self._dispatch(batch)
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._pending = self._pending, []
+        if batch:
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: list[tuple[bytes, bytes]]) -> None:
+        # The aggregator's table state is donated between steps; concurrent
+        # ingest calls would race on a deleted buffer.
+        with self._dispatch_lock, metrics.measure("ct-fetch", "storeCertificate"):
+            result = self.aggregator.ingest(batch)
+        metrics.incr_counter(
+            "ct-fetch", "insertCertificate", value=float(len(batch))
+        )
+        del result
+
+
+@dataclass
+class _QueueItem:
+    entry: DecodedEntry
+    log_url: str
+
+
+class LogWorker:
+    """Download worker for one log (ct-fetch.go:248-488).
+
+    Resolves the resume window on construction: start = saved
+    ``MaxEntry`` unless ``offset`` overrides; end = STH tree size - 1,
+    clamped by ``limit`` (ct-fetch.go:288-305).
+    """
+
+    def __init__(
+        self,
+        client: CTLogClient,
+        database,
+        offset: int = 0,
+        limit: int = 0,
+    ):
+        self.client = client
+        self.database = database
+        self.sth = client.get_sth()
+        self.log_state: CertificateLog = database.get_log_state(client.short_url)
+        if offset > 0:
+            self.start_pos = offset
+        else:
+            self.start_pos = self.log_state.max_entry
+        tree_end = self.sth.tree_size - 1
+        if limit > 0:
+            self.end_pos = min(self.start_pos + limit - 1, tree_end)
+        else:
+            self.end_pos = tree_end
+        self.position = self.start_pos
+        self.last_entry_time: Optional[datetime] = None
+
+    def save_state(self) -> None:
+        """Persist the cursor (ct-fetch.go:371-392): dual-written by
+        the database facade (cache + backend)."""
+        self.log_state.max_entry = self.position
+        if self.last_entry_time is not None:
+            self.log_state.last_entry_time = self.last_entry_time
+        self.log_state.last_update_time = datetime.now(timezone.utc)
+        with metrics.measure("LogWorker", self.client.short_url, "saveState"):
+            self.database.save_log_state(self.log_state)
+
+    def run(
+        self,
+        out: "queue.Queue[Optional[_QueueItem]]",
+        stop: threading.Event,
+        save_period_s: float = 900.0,
+        progress=None,
+    ) -> int:
+        """Stream ``[start_pos, end_pos]`` into the queue; returns the
+        number of entries enqueued. Checkpoints on a ticker and at exit
+        (ct-fetch.go:360-368,472-473)."""
+        enqueued = 0
+        next_save = time.monotonic() + save_period_s
+        index = self.position
+        while index <= self.end_pos and not stop.is_set():
+            batch = self.client.get_raw_entries(
+                index, min(index + BATCH_SIZE - 1, self.end_pos)
+            )
+            if not batch:
+                break
+            for raw in batch:
+                try:
+                    with metrics.measure(
+                        "LogWorker", self.client.short_url, "parseLeaf"
+                    ):
+                        entry = decode_json_entry(
+                            raw.index,
+                            {"leaf_input": raw.leaf_input,
+                             "extra_data": raw.extra_data},
+                        )
+                except LeafDecodeError:
+                    metrics.incr_counter(
+                        "LogWorker", self.client.short_url, "parseLeafError"
+                    )
+                    continue
+                finally:
+                    index = raw.index + 1
+                self.last_entry_time = datetime.fromtimestamp(
+                    entry.timestamp_ms / 1000.0, tz=timezone.utc
+                )
+                # select{signal | save | submit} (ct-fetch.go:466-480)
+                submitted = False
+                while not stop.is_set():
+                    try:
+                        with metrics.measure(
+                            "LogWorker", self.client.short_url, "submitToChannel"
+                        ):
+                            out.put(_QueueItem(entry, self.client.log_url),
+                                    timeout=0.25)
+                        enqueued += 1
+                        submitted = True
+                        break
+                    except queue.Full:
+                        continue
+                if not submitted:
+                    # Stopped while the queue was full: do NOT advance the
+                    # cursor past an entry that never reached a worker —
+                    # resume must re-fetch it.
+                    break
+                self.position = raw.index + 1
+                if progress is not None:
+                    progress(self.client.short_url, self.position, self.end_pos)
+                if time.monotonic() >= next_save:
+                    self.save_state()
+                    next_save = time.monotonic() + save_period_s
+                if stop.is_set():
+                    break
+        self.save_state()
+        return enqueued
+
+
+class LogSyncEngine:
+    """Queue + worker-pool runtime (ct-fetch.go:83-178).
+
+    ``start_store_threads`` spawns the consumers; ``sync_log`` spawns
+    one downloader thread per URL; ``stop`` + ``join`` replicate the
+    WaitGroup shutdown ordering of main() (ct-fetch.go:610-620).
+    """
+
+    def __init__(
+        self,
+        sink: EntrySink,
+        database,
+        num_threads: int = 1,
+        queue_capacity: int = ENTRY_QUEUE_CAPACITY,
+        offset: int = 0,
+        limit: int = 0,
+        save_period_s: float = 900.0,
+    ):
+        self.sink = sink
+        self.database = database
+        self.num_threads = num_threads
+        self.offset = offset
+        self.limit = limit
+        self.save_period_s = save_period_s
+        self.entry_queue: "queue.Queue[Optional[_QueueItem]]" = queue.Queue(
+            maxsize=queue_capacity
+        )
+        self.stop_event = threading.Event()
+        self._store_threads: list[threading.Thread] = []
+        self._download_threads: list[threading.Thread] = []
+        self._last_update_lock = threading.Lock()
+        self._last_updates: dict[str, datetime] = {}
+        self._progress: dict[str, tuple[int, int]] = {}
+        self.errors: list[str] = []
+
+    # -- health surface (ct-fetch.go:567-597) ---------------------------
+    def last_updates(self) -> dict[str, datetime]:
+        with self._last_update_lock:
+            return dict(self._last_updates)
+
+    def progress(self) -> dict[str, tuple[int, int]]:
+        with self._last_update_lock:
+            return dict(self._progress)
+
+    def _note_progress(self, short_url: str, pos: int, end: int) -> None:
+        with self._last_update_lock:
+            self._last_updates[short_url] = datetime.now(timezone.utc)
+            self._progress[short_url] = (pos, end)
+
+    # -- consumers ------------------------------------------------------
+    def _store_worker(self) -> None:
+        while True:
+            item = self.entry_queue.get()
+            try:
+                if item is None:
+                    return
+                try:
+                    self.sink.store(item.entry, item.log_url)
+                except Exception as err:
+                    # A store failure must not kill the worker — the queue
+                    # would back up and stop() would deadlock on join().
+                    metrics.incr_counter("ct-fetch", "storeError")
+                    self.errors.append(
+                        f"store {item.log_url}@{item.entry.index}: {err}"
+                    )
+            finally:
+                self.entry_queue.task_done()
+
+    def start_store_threads(self) -> None:
+        for i in range(self.num_threads):
+            t = threading.Thread(
+                target=self._store_worker, name=f"store-{i}", daemon=True
+            )
+            t.start()
+            self._store_threads.append(t)
+
+    # -- producers ------------------------------------------------------
+    def sync_log(self, log_url: str, transport=None) -> threading.Thread:
+        def run() -> None:
+            try:
+                client = CTLogClient(log_url, transport=transport)
+                worker = LogWorker(
+                    client, self.database, offset=self.offset, limit=self.limit
+                )
+                self._note_progress(client.short_url, worker.position, worker.end_pos)
+                worker.run(
+                    self.entry_queue,
+                    self.stop_event,
+                    save_period_s=self.save_period_s,
+                    progress=self._note_progress,
+                )
+            except Exception as err:  # log-level failures never kill the run
+                metrics.incr_counter("ct-fetch", "syncLogError")
+                self.errors.append(f"{log_url}: {err}")
+
+        t = threading.Thread(target=run, name=f"sync-{log_url}", daemon=True)
+        t.start()
+        self._download_threads.append(t)
+        return t
+
+    # -- lifecycle ------------------------------------------------------
+    def wait_for_downloads(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for t in self._download_threads:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            t.join(remaining)
+
+    def stop(self) -> None:
+        """Drain and terminate the store workers (ct-fetch.go:167-171)."""
+        self.entry_queue.join()
+        for _ in self._store_threads:
+            self.entry_queue.put(None)
+        for t in self._store_threads:
+            t.join()
+        self._store_threads.clear()
+        self.sink.flush()
+
+    def signal_stop(self) -> None:
+        self.stop_event.set()
+
+    def cleanup(self) -> None:
+        self.database.cleanup()
+
+
+def polling_delay(mean_s: float, std_dev_pct: float) -> float:
+    """runForever inter-poll sleep: normal around the mean, clamped
+    positive (the reference draws from a normal distribution with the
+    configured mean/stddev percentage)."""
+    return max(1.0, random.gauss(mean_s, mean_s * std_dev_pct / 100.0))

@@ -1,0 +1,101 @@
+"""An in-process CT log served through the injectable transport.
+
+The reference tests against real logs + a real Redis; this
+zero-egress environment instead synthesizes a wire-faithful log: real
+signed templates (tests/certgen or utils/syncerts), RFC 6962 leaf
+encoding, and a transport callable that answers get-sth / get-entries
+/ get-entry-and-proof exactly like a log server would.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+from urllib.parse import parse_qs, urlparse
+
+from ct_mapreduce_tpu.ingest import leaf as leaflib
+
+
+class FakeLog:
+    def __init__(self, url: str = "https://ct.example.com/fake"):
+        self.url = url
+        self.entries: list[dict] = []  # {"leaf_input": b64, "extra_data": b64}
+        self.max_batch = 1000
+        self.rate_limit_hits = 0  # serve this many 429s before succeeding
+        self.retry_after: str | None = None
+        self.requests: list[str] = []
+
+    def add_cert(self, cert_der: bytes, issuer_der: bytes, timestamp_ms: int = 0):
+        li = leaflib.encode_leaf_input(cert_der, timestamp_ms)
+        ed = leaflib.encode_extra_data([issuer_der])
+        self.entries.append(
+            {
+                "leaf_input": base64.b64encode(li).decode(),
+                "extra_data": base64.b64encode(ed).decode(),
+            }
+        )
+
+    def add_precert(
+        self, precert_der: bytes, issuer_der: bytes, timestamp_ms: int = 0
+    ):
+        li = leaflib.encode_leaf_input(
+            b"\x00" * 10,  # TBS stand-in; the store path uses extra_data
+            timestamp_ms,
+            entry_type=leaflib.PRECERT_ENTRY,
+        )
+        ed = leaflib.encode_extra_data(
+            [issuer_der],
+            entry_type=leaflib.PRECERT_ENTRY,
+            pre_certificate=precert_der,
+        )
+        self.entries.append(
+            {
+                "leaf_input": base64.b64encode(li).decode(),
+                "extra_data": base64.b64encode(ed).decode(),
+            }
+        )
+
+    def add_garbage(self):
+        self.entries.append(
+            {
+                "leaf_input": base64.b64encode(b"\xff\xff").decode(),
+                "extra_data": "",
+            }
+        )
+
+    # -- transport -------------------------------------------------------
+    def transport(self, url: str) -> tuple[int, dict, bytes]:
+        self.requests.append(url)
+        if self.rate_limit_hits > 0:
+            self.rate_limit_hits -= 1
+            headers = {}
+            if self.retry_after is not None:
+                headers["Retry-After"] = self.retry_after
+            return 429, headers, b"slow down"
+        parsed = urlparse(url)
+        if parsed.path.endswith("/ct/v1/get-sth"):
+            return 200, {}, json.dumps(
+                {"tree_size": len(self.entries), "timestamp": 1700000000000}
+            ).encode()
+        if parsed.path.endswith("/ct/v1/get-entries"):
+            q = parse_qs(parsed.query)
+            start = int(q["start"][0])
+            end = min(
+                int(q["end"][0]), start + self.max_batch - 1, len(self.entries) - 1
+            )
+            if start >= len(self.entries):
+                return 400, {}, b"range beyond tree size"
+            return 200, {}, json.dumps(
+                {"entries": self.entries[start : end + 1]}
+            ).encode()
+        m = re.search(r"/ct/v1/get-entry-and-proof$", parsed.path)
+        if m:
+            q = parse_qs(parsed.query)
+            idx = int(q["leaf_index"][0])
+            e = self.entries[idx]
+            return 200, {}, json.dumps(
+                {"leaf_input": e["leaf_input"], "extra_data": e["extra_data"],
+                 "audit_path": []}
+            ).encode()
+        return 404, {}, b"not found"

@@ -1,0 +1,288 @@
+"""Ingest layer: leaf decode, client retry, sync engine end-to-end.
+
+Mirrors the reference's ingest behaviors: RFC 6962 leaf handling
+(ct-fetch.go:452), 429 backoff (ct-fetch.go:409-437), resume-from-
+checkpoint (ct-fetch.go:288-305), tolerate-bad-entries
+(ct-fetch.go:452-460), and the queue → worker store path
+(ct-fetch.go:140-246).
+"""
+
+import datetime
+import threading
+
+import pytest
+
+from ct_mapreduce_tpu.core import der as hostder
+from ct_mapreduce_tpu.core.types import CertificateLog, ExpDate, Issuer, Serial
+from ct_mapreduce_tpu.ingest import (
+    CTLogClient,
+    LogSyncEngine,
+    LogWorker,
+    decode_entry,
+    short_url,
+)
+from ct_mapreduce_tpu.ingest import leaf as leaflib
+from ct_mapreduce_tpu.ingest.health import HealthServer
+from ct_mapreduce_tpu.ingest.sync import AggregatorSink, DatabaseSink, polling_delay
+from ct_mapreduce_tpu.storage.certdb import FilesystemDatabase
+from ct_mapreduce_tpu.storage.mockbackend import MockBackend
+from ct_mapreduce_tpu.storage.mockcache import MockRemoteCache
+
+from tests import certgen
+from tests.fakelog import FakeLog
+
+UTC = datetime.timezone.utc
+FUTURE = datetime.datetime(2031, 6, 15, tzinfo=UTC)
+
+
+def _leaf_and_issuer(serial: int, issuer_cn: str = "Ingest CA"):
+    issuer_der = certgen.make_cert(
+        serial=1, issuer_cn=issuer_cn, is_ca=True, not_after=FUTURE
+    )
+    leaf_der = certgen.make_cert(
+        serial=serial,
+        issuer_cn=issuer_cn,
+        subject_cn="leaf.example.com",
+        is_ca=False,
+        not_after=FUTURE,
+    )
+    return leaf_der, issuer_der
+
+
+# -- leaf codec -------------------------------------------------------------
+
+
+def test_leaf_roundtrip_x509():
+    leaf_der, issuer_der = _leaf_and_issuer(7)
+    li = leaflib.encode_leaf_input(leaf_der, timestamp_ms=1234)
+    ed = leaflib.encode_extra_data([issuer_der])
+    e = decode_entry(42, li, ed)
+    assert e.index == 42
+    assert e.timestamp_ms == 1234
+    assert not e.is_precert
+    assert e.cert_der == leaf_der
+    assert e.issuer_der == issuer_der
+
+
+def test_leaf_roundtrip_precert():
+    leaf_der, issuer_der = _leaf_and_issuer(9)
+    li = leaflib.encode_leaf_input(
+        b"\x01" * 8, timestamp_ms=99, entry_type=leaflib.PRECERT_ENTRY,
+        issuer_key_hash=b"\xab" * 32,
+    )
+    ed = leaflib.encode_extra_data(
+        [issuer_der], entry_type=leaflib.PRECERT_ENTRY, pre_certificate=leaf_der
+    )
+    e = decode_entry(0, li, ed)
+    assert e.is_precert
+    # The stored cert is the SUBMITTED precert from extra_data
+    # (ct-fetch.go:202-204), not the leaf_input TBS.
+    assert e.cert_der == leaf_der
+    assert e.issuer_key_hash == b"\xab" * 32
+    assert e.issuer_der == issuer_der
+
+
+def test_leaf_truncated_raises():
+    with pytest.raises(leaflib.LeafDecodeError):
+        leaflib.decode_leaf_input(b"\x00\x00\x01")
+
+
+def test_short_url():
+    assert short_url("https://ct.example.com/log/") == "ct.example.com/log"
+    assert short_url("ct.example.com/log") == "ct.example.com/log"
+
+
+# -- client -----------------------------------------------------------------
+
+
+def test_client_sth_and_entries():
+    log = FakeLog()
+    leaf, issuer = _leaf_and_issuer(1)
+    for s in range(5):
+        log.add_cert(leaf, issuer, timestamp_ms=s)
+    c = CTLogClient(log.url, transport=log.transport)
+    sth = c.get_sth()
+    assert sth.tree_size == 5
+    entries = c.get_raw_entries(1, 3)
+    assert [e.index for e in entries] == [1, 2, 3]
+
+
+def test_client_429_backoff_and_retry_after():
+    log = FakeLog()
+    leaf, issuer = _leaf_and_issuer(2)
+    log.add_cert(leaf, issuer)
+    log.rate_limit_hits = 2
+    log.retry_after = "3"
+    sleeps = []
+    c = CTLogClient(log.url, transport=log.transport, sleep=sleeps.append)
+    sth = c.get_sth()
+    assert sth.tree_size == 1
+    assert sleeps == [3.0, 3.0]  # Retry-After honored, then success
+
+    log.rate_limit_hits = 1
+    log.retry_after = None
+    sleeps.clear()
+    c.get_sth()
+    assert len(sleeps) == 1 and 0 < sleeps[0] <= 300.0  # jittered window
+
+
+# -- LogWorker resume window ------------------------------------------------
+
+
+def _db():
+    return FilesystemDatabase(MockBackend(), MockRemoteCache())
+
+
+def test_worker_resume_and_limit():
+    log = FakeLog()
+    leaf, issuer = _leaf_and_issuer(3)
+    for s in range(10):
+        log.add_cert(leaf, issuer)
+    db = _db()
+    state = CertificateLog(short_url="ct.example.com/fake", max_entry=4)
+    db.save_log_state(state)
+
+    c = CTLogClient(log.url, transport=log.transport)
+    w = LogWorker(c, db)
+    assert (w.start_pos, w.end_pos) == (4, 9)
+
+    w2 = LogWorker(c, db, offset=7)
+    assert w2.start_pos == 7
+    w3 = LogWorker(c, db, limit=2)
+    assert (w3.start_pos, w3.end_pos) == (4, 5)
+
+
+# -- end-to-end sync: DatabaseSink ------------------------------------------
+
+
+def test_sync_end_to_end_database_sink():
+    log = FakeLog()
+    issuer_der = certgen.make_cert(serial=1, issuer_cn="E2E CA", is_ca=True,
+                                   not_after=FUTURE)
+    serials = [100, 101, 102, 101, 100, 103]  # dupes dedup to 4
+    for s in serials:
+        leaf = certgen.make_cert(
+            serial=s, issuer_cn="E2E CA", subject_cn="x.example.com",
+            is_ca=False, not_after=FUTURE,
+        )
+        log.add_cert(leaf, issuer_der)
+    log.add_garbage()  # tolerated, skipped (ct-fetch.go:452-460)
+    ca_cert = certgen.make_cert(serial=200, issuer_cn="E2E CA", is_ca=True,
+                                not_after=FUTURE)
+    log.add_cert(ca_cert, issuer_der)  # filtered out: CA
+
+    db = _db()
+    sink = DatabaseSink(db, now=datetime.datetime(2025, 1, 1, tzinfo=UTC))
+    engine = LogSyncEngine(sink, db, num_threads=2)
+    engine.start_store_threads()
+    engine.sync_log(log.url, transport=log.transport)
+    engine.wait_for_downloads(timeout=30)
+    engine.stop()
+
+    issuer = Issuer.from_spki(certgen.spki_of(issuer_der))
+    exp = ExpDate.from_time(hostder.parse_cert(issuer_der).not_after)
+    known = db.get_known_certificates(exp, issuer)
+    assert known.count() == 4
+    for s in (100, 101, 102, 103):
+        assert not known.was_unknown(Serial.from_der_cert(
+            certgen.make_cert(serial=s, issuer_cn="E2E CA",
+                              subject_cn="x.example.com", is_ca=False,
+                              not_after=FUTURE)))
+    # Checkpoint advanced to tree size.
+    st = db.get_log_state("ct.example.com/fake")
+    assert st.max_entry == 8
+    assert st.last_update_time is not None
+
+
+def test_sync_stop_event_checkpoints():
+    log = FakeLog()
+    leaf, issuer = _leaf_and_issuer(5)
+    for _ in range(30):
+        log.add_cert(leaf, issuer)
+    db = _db()
+    sink = DatabaseSink(db, now=datetime.datetime(2025, 1, 1, tzinfo=UTC))
+    engine = LogSyncEngine(sink, db, num_threads=1, limit=10)
+    engine.start_store_threads()
+    engine.sync_log(log.url, transport=log.transport)
+    engine.wait_for_downloads(timeout=30)
+    engine.stop()
+    st = db.get_log_state("ct.example.com/fake")
+    assert st.max_entry == 10  # limit clamp honored
+
+
+# -- end-to-end sync: AggregatorSink (device path) --------------------------
+
+
+def test_sync_end_to_end_aggregator_sink():
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+
+    log = FakeLog()
+    issuer_der = certgen.make_cert(serial=1, issuer_cn="Agg CA", is_ca=True,
+                                   not_after=FUTURE)
+    for s in [500, 501, 500, 502]:
+        leaf = certgen.make_cert(
+            serial=s, issuer_cn="Agg CA", subject_cn="y.example.com",
+            is_ca=False, not_after=FUTURE,
+        )
+        log.add_cert(leaf, issuer_der)
+
+    agg = TpuAggregator(
+        capacity=1 << 12, batch_size=64,
+        now=datetime.datetime(2025, 1, 1, tzinfo=UTC),
+    )
+    db = _db()
+    sink = AggregatorSink(agg, flush_size=3)
+    engine = LogSyncEngine(sink, db, num_threads=1)
+    engine.start_store_threads()
+    engine.sync_log(log.url, transport=log.transport)
+    engine.wait_for_downloads(timeout=60)
+    engine.stop()
+
+    snap = agg.drain()
+    assert snap.total == 3  # 500, 501, 502
+    assert sink.entries_in == 4
+
+
+# -- health -----------------------------------------------------------------
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.updates = {}
+
+    def last_updates(self):
+        return dict(self.updates)
+
+
+def test_health_transitions():
+    eng = _FakeEngine()
+    h = HealthServer(eng, polling_delay_mean_s=10.0, addr="127.0.0.1:0")
+    code, body = h.status()
+    assert code == 503  # before first update (ct-fetch.go:584-588)
+    eng.updates["log"] = datetime.datetime.now(UTC)
+    code, body = h.status()
+    assert code == 200 and body["status"] == "ok"
+    eng.updates["log"] = datetime.datetime.now(UTC) - datetime.timedelta(seconds=25)
+    code, body = h.status()
+    assert code == 500 and "log" in body["stalled"]
+
+
+def test_health_http_server():
+    import urllib.request
+
+    eng = _FakeEngine()
+    eng.updates["log"] = datetime.datetime.now(UTC)
+    h = HealthServer(eng, polling_delay_mean_s=10.0, addr="127.0.0.1:0")
+    h.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{h.port}/health", timeout=5
+        ) as resp:
+            assert resp.status == 200
+    finally:
+        h.stop()
+
+
+def test_polling_delay_positive():
+    for _ in range(100):
+        assert polling_delay(600.0, 10) >= 1.0
