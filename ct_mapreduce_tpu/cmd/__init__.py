@@ -1,0 +1,2 @@
+"""CLI entry points, mirroring the reference's three binaries
+(/root/reference/cmd/): ct-fetch, storage-statistics, ct-getcert."""

@@ -1,0 +1,49 @@
+"""ct-getcert: fetch one CT entry by index and print its PEM.
+
+Reference: /root/reference/cmd/ct-getcert/ct-getcert.go:16-57 — flags
+-log and -index, GetRawEntries(index, index), tolerate non-fatal parse
+issues, PEM to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ct_mapreduce_tpu.core.der import der_to_pem
+from ct_mapreduce_tpu.ingest.ctclient import CTLogClient
+from ct_mapreduce_tpu.ingest.leaf import LeafDecodeError, decode_json_entry
+
+
+def main(argv: list[str] | None = None, transport=None, out=None) -> int:
+    parser = argparse.ArgumentParser(prog="ct-getcert")
+    parser.add_argument("-log", "--log", required=True, help="log URL")
+    parser.add_argument("-index", "--index", type=int, default=0, help="index")
+    args = parser.parse_args(argv)
+    out = out or sys.stdout
+
+    client = CTLogClient(args.log, transport=transport)
+    entries = client.get_raw_entries(args.index, args.index)
+    if not entries:
+        print(f"[{args.log}] no entry at index {args.index}", file=sys.stderr)
+        return 1
+    for raw in entries:
+        try:
+            entry = decode_json_entry(
+                raw.index,
+                {"leaf_input": raw.leaf_input, "extra_data": raw.extra_data},
+            )
+        except LeafDecodeError as err:
+            print(
+                f"Erroneous certificate: log={args.log} index={raw.index} "
+                f"err={err}",
+                file=sys.stderr,
+            )
+            continue
+        pem = der_to_pem(entry.cert_der)
+        out.write(pem.decode() if isinstance(pem, bytes) else pem)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

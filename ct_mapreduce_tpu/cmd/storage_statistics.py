@@ -1,0 +1,160 @@
+"""storage-statistics: the reduce-side report.
+
+Reference (/root/reference/cmd/storage-statistics/storage-statistics.go:22-100):
+enumerate issuers×dates from the cache keyspace, print per-issuer serial
+counts, CRL counts, DN counts, overall totals, then per-log checkpoint
+states. Verbosity tiers: -v 1 adds per-expDate serial counts, -v 2 adds
+the serial list, -v 3 dumps PEMs from the backend.
+
+``--backend=tpu`` (BASELINE.json's north star) drains the on-device
+aggregate snapshot written by ``ct-fetch`` (``aggStatePath``) instead
+of walking a Redis keyspace — same report, no per-key round trips.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ct_mapreduce_tpu.config import CTConfig
+from ct_mapreduce_tpu.engine import get_configured_storage, prepare_telemetry
+
+
+def _verbosity(argv: list[str] | None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    v = 0
+    for i, a in enumerate(args):
+        if a in ("-v", "--v") and i + 1 < len(args):
+            try:
+                v = int(args[i + 1])
+            except ValueError:
+                pass
+        elif a.startswith(("-v=", "--v=")):
+            try:
+                v = int(a.split("=", 1)[1])
+            except ValueError:
+                pass
+    return v
+
+
+def report_from_tpu_snapshot(config: CTConfig, out, verbosity: int = 0) -> int:
+    """Drain path: aggregate snapshot → the same report shape."""
+    import os
+
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+
+    path = config.agg_state_path
+    if not path or not os.path.exists(path):
+        print(
+            f"error: aggStatePath not found: {path!r} "
+            "(run ct-fetch with backend=tpu first)",
+            file=out,
+        )
+        return 1
+    agg = TpuAggregator(capacity=1 << 10)
+    agg.load_checkpoint(path)
+    snap = agg.drain()
+
+    # Regroup (issuer, expdate) → issuer.
+    by_issuer: dict[str, dict[str, int]] = {}
+    for (iss, exp), count in snap.counts.items():
+        by_issuer.setdefault(iss, {})[exp] = count
+
+    total_serials = 0
+    total_crls = 0
+    for iss in snap.issuers():
+        dates = by_issuer.get(iss, {})
+        crls = sorted(snap.crls.get(iss, ()))
+        dns = sorted(snap.dns.get(iss, ()))
+        total_crls += len(crls)
+        issuer_serials = sum(dates.values())
+        total_serials += issuer_serials
+        print(f"Issuer: {iss} ({dns})", file=out)
+        if verbosity >= 1:
+            for exp in sorted(dates):
+                print(f"- {exp} ({dates[exp]} serials)", file=out)
+        print(
+            f" --> {len(dates)} hours, {issuer_serials} serials known, "
+            f"{len(crls)} crls known, {len(dns)} issuerDNs known",
+            file=out,
+        )
+    print(
+        f"overall totals: {len(snap.issuers())} issuers, "
+        f"{total_serials} serials, {total_crls} crls",
+        file=out,
+    )
+    return 0
+
+
+def report_from_database(config: CTConfig, out, verbosity: int = 0) -> int:
+    """Cache-walk path (reference parity)."""
+    database, _cache, backend = get_configured_storage(config)
+    issuer_list = database.get_issuer_and_dates_from_cache()
+
+    total_serials = 0
+    total_crls = 0
+    for issuer_obj in issuer_list:
+        meta = database.get_issuer_metadata(issuer_obj.issuer)
+        crl_list = meta.crls()
+        total_crls += len(crl_list)
+        dn_list = meta.issuers()
+        count_issuer_serials = 0
+        print(f"Issuer: {issuer_obj.issuer.id()} ({sorted(dn_list)})", file=out)
+        for exp_date in issuer_obj.exp_dates:
+            known = database.get_known_certificates(exp_date, issuer_obj.issuer)
+            count = known.count()
+            count_issuer_serials += count
+            total_serials += count
+            if verbosity >= 1:
+                print(f"- {exp_date.id()} ({count} serials)", file=out)
+            if verbosity >= 2:
+                known_list = known.known()
+                print(f"  Serials: {[s.id() for s in known_list]}", file=out)
+                if verbosity >= 3:
+                    for serial in known_list:
+                        print(
+                            f"Certificate serial={{{serial.hex_string()}}} / "
+                            f"{{{serial.id()}}}",
+                            file=out,
+                        )
+                        try:
+                            pem = backend.load_certificate_pem(
+                                serial, exp_date, issuer_obj.issuer
+                            )
+                            out.write(pem if isinstance(pem, str)
+                                      else pem.decode())
+                        except Exception as err:
+                            print(f"error: {err}", file=out)
+        print(
+            f" --> {len(issuer_obj.exp_dates)} hours, "
+            f"{count_issuer_serials} serials known, "
+            f"{len(crl_list)} crls known, {len(dn_list)} issuerDNs known",
+            file=out,
+        )
+    print(
+        f"overall totals: {len(issuer_list)} issuers, {total_serials} serials, "
+        f"{total_crls} crls",
+        file=out,
+    )
+
+    if config.log_url_list and len(config.log_url_list) > 5:
+        print("", file=out)
+        print("Log status:", file=out)
+        for url in config.log_urls():
+            from ct_mapreduce_tpu.ingest.ctclient import short_url
+
+            state = database.get_log_state(short_url(url))
+            print(str(state), file=out)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    config = CTConfig.load(argv)
+    prepare_telemetry("storage-statistics", config)
+    verbosity = _verbosity(argv)
+    if config.backend == "tpu":
+        return report_from_tpu_snapshot(config, sys.stdout, verbosity)
+    return report_from_database(config, sys.stdout, verbosity)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,7 @@ class CTConfig:
     table_bits: int = 22  # dedup table slots = 2**table_bits per shard
     mesh_shape: str = ""  # e.g. "data:4,expert:2"; empty = all devices on data
     device_queue_depth: int = 2
+    agg_state_path: str = ""  # .npz snapshot of device aggregates (tpu backend)
 
     _DIRECTIVES = {
         # directive name -> (field, type)
@@ -79,6 +80,7 @@ class CTConfig:
         "tableBits": ("table_bits", int),
         "meshShape": ("mesh_shape", str),
         "deviceQueueDepth": ("device_queue_depth", int),
+        "aggStatePath": ("agg_state_path", str),
     }
 
     @classmethod
@@ -211,6 +213,7 @@ class CTConfig:
             "tableBits = log2 of dedup-table slots per shard",
             "meshShape = device mesh, e.g. data:4,expert:2",
             "deviceQueueDepth = host->device prefetch depth",
+            "aggStatePath = Path for the on-device aggregate snapshot (.npz)",
         ]
         return "\n".join(lines)
 

@@ -108,12 +108,18 @@ class CTLogClient:
                 # ct-fetch.go:426-437: jittered 500ms-5min, honor
                 # Retry-After seconds when the server sends one.
                 incr_counter("LogWorker", self.short_url, "429")
-                retry_after = headers.get("Retry-After")
+                retry_after = next(
+                    (v for k, v in headers.items()
+                     if k.lower() == "retry-after"),
+                    None,
+                )
                 if retry_after:
                     try:
                         # Clamp to the 500ms-5min window — a hostile value
-                        # must not stall the downloader arbitrarily long.
-                        delay = min(max(float(retry_after), 0.0), backoff.max_s)
+                        # must neither stall the downloader for hours nor
+                        # turn the retry loop into a zero-delay hammer.
+                        delay = min(max(float(retry_after), backoff.min_s),
+                                    backoff.max_s)
                     except ValueError:
                         delay = backoff.duration()
                 else:

@@ -235,6 +235,9 @@ class LogWorker:
                     metrics.incr_counter(
                         "LogWorker", self.client.short_url, "parseLeafError"
                     )
+                    # Tolerated skip IS durable: the cursor moves past the
+                    # bad entry so restarts don't re-fetch it forever.
+                    self.position = raw.index + 1
                     continue
                 finally:
                     index = raw.index + 1

@@ -170,6 +170,7 @@ def test_sync_end_to_end_database_sink():
     ca_cert = certgen.make_cert(serial=200, issuer_cn="E2E CA", is_ca=True,
                                 not_after=FUTURE)
     log.add_cert(ca_cert, issuer_der)  # filtered out: CA
+    log.add_garbage()  # TRAILING garbage: cursor must still advance past it
 
     db = _db()
     sink = DatabaseSink(db, now=datetime.datetime(2025, 1, 1, tzinfo=UTC))
@@ -188,9 +189,10 @@ def test_sync_end_to_end_database_sink():
             certgen.make_cert(serial=s, issuer_cn="E2E CA",
                               subject_cn="x.example.com", is_ca=False,
                               not_after=FUTURE)))
-    # Checkpoint advanced to tree size.
+    # Checkpoint advanced to tree size — including past trailing
+    # undecodable entries (tolerated skips are durable).
     st = db.get_log_state("ct.example.com/fake")
-    assert st.max_entry == 8
+    assert st.max_entry == 9
     assert st.last_update_time is not None
 
 
