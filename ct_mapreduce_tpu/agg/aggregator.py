@@ -142,7 +142,7 @@ class TpuAggregator:
         max_probes: int = 32,
         now: Optional[datetime] = None,
     ) -> None:
-        self.table = hashtable.make_table(capacity)
+        self.table = self._make_table(capacity)
         self.capacity = capacity
         self.batch_size = batch_size
         self.base_hour = base_hour
@@ -163,6 +163,13 @@ class TpuAggregator:
             "inserted": 0, "known": 0, "filtered_ca": 0, "filtered_expired": 0,
             "filtered_cn": 0, "host_lane": 0, "parse_errors": 0, "overflow": 0,
         }
+
+    # -- state hooks (overridden by the mesh-sharded subclass) -----------
+    def _make_table(self, capacity: int):
+        return hashtable.make_table(capacity)
+
+    def _drain_table(self) -> tuple[np.ndarray, np.ndarray]:
+        return hashtable.drain_np(self.table)
 
     # -- config ----------------------------------------------------------
     def set_cn_prefixes(self, prefixes: tuple[str, ...]) -> None:
@@ -370,7 +377,7 @@ class TpuAggregator:
         """Pull device state to host and merge with the host lane —
         the data storage-statistics prints
         (/root/reference/cmd/storage-statistics/storage-statistics.go:28-99)."""
-        _, meta = hashtable.drain_np(self.table)
+        _, meta = self._drain_table()
         counts: dict[tuple[str, str], int] = {}
         if meta.size:
             uniq, cnt = np.unique(meta, return_counts=True)

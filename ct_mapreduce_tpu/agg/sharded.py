@@ -307,6 +307,72 @@ class ShardedDedup:
         )
         return out
 
+    def _bulk_insert_fn(self, width: int):
+        cache_key = ("bulk", width)
+        fn = self._step_cache.get(cache_key)
+        if fn is not None:
+            return fn
+
+        def local(table_keys, table_meta, table_count, send, meta, valid):
+            state = hashtable.TableState(table_keys, table_meta, table_count)
+            state, _, overflow = hashtable.insert(
+                state, send[0], meta[0], valid[0], max_probes=self.max_probes
+            )
+            return (
+                state.keys, state.meta, state.count,
+                jnp.sum(overflow, dtype=jnp.int32)[None],
+            )
+
+        mapped = jax.shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+            out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+            check_vma=False,
+        )
+        fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
+        self._step_cache[cache_key] = fn
+        return fn
+
+    def bulk_insert_np(
+        self, keys_np: np.ndarray, meta_np: np.ndarray, chunk: int = 65536
+    ) -> int:
+        """Reinsert pre-hashed (fingerprint, meta) rows — the
+        topology-independent restore path. Rows are routed to their home
+        shard on the host (this runs once per restore, not per batch),
+        then inserted per-shard under shard_map. Returns the number of
+        rows that overflowed probing (0 unless the table is undersized)."""
+        n = self.n_shards
+        if keys_np.size == 0:
+            return 0
+        # uint32 wraparound arithmetic, matching _shard_of on device.
+        k = keys_np.astype(np.uint32)
+        h = k[:, 2] ^ (k[:, 3] * np.uint32(0x85EBCA6B))
+        dest = (h % np.uint32(n)).astype(np.int64)
+        per_shard = [np.flatnonzero(dest == i) for i in range(n)]
+        max_len = max(idx.size for idx in per_shard)
+        overflowed = 0
+        batch_sharding = NamedSharding(self.mesh, P(AXIS))
+        for start in range(0, max_len, chunk):
+            width = min(chunk, max_len - start)
+            send = np.zeros((n, width, 4), np.uint32)
+            meta = np.zeros((n, width), np.uint32)
+            valid = np.zeros((n, width), bool)
+            for i, idx in enumerate(per_shard):
+                sl = idx[start : start + width]
+                send[i, : sl.size] = keys_np[sl]
+                meta[i, : sl.size] = meta_np[sl]
+                valid[i, : sl.size] = True
+            fn = self._bulk_insert_fn(width)
+            self.keys, self.meta, self.count, ovf = fn(
+                self.keys, self.meta, self.count,
+                jax.device_put(jnp.asarray(send), batch_sharding),
+                jax.device_put(jnp.asarray(meta), batch_sharding),
+                jax.device_put(jnp.asarray(valid), batch_sharding),
+            )
+            overflowed += int(jnp.sum(ovf))
+        return overflowed
+
     def total_count(self) -> int:
         return int(jnp.sum(self.count))
 

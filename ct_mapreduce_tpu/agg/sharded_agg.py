@@ -1,0 +1,126 @@
+"""Exact-parity aggregator over the mesh-sharded dedup.
+
+:class:`ShardedAggregator` is :class:`TpuAggregator` with the device
+path swapped for :class:`~ct_mapreduce_tpu.agg.sharded.ShardedDedup`:
+batches shard along the batch axis, keys route to their home table
+shard over ICI ``all_to_all``, per-issuer counts come back ``psum``'d —
+while the host-side exact lane, issuer registry, CRL/DN accumulation,
+drain, and checkpoint contract stay identical. One process drives the
+whole mesh (multi-host runs drive the global mesh via
+``jax.distributed``; see ct_mapreduce_tpu.parallel.distributed).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Optional
+
+import numpy as np
+
+from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+from ct_mapreduce_tpu.agg.sharded import ShardedDedup
+from ct_mapreduce_tpu.core import packing
+
+
+class ShardedAggregator(TpuAggregator):
+    def __init__(
+        self,
+        mesh,
+        capacity: int = 1 << 22,
+        batch_size: int = 4096,
+        base_hour: int = packing.DEFAULT_BASE_HOUR,
+        cn_prefixes: tuple[str, ...] = (),
+        max_probes: int = 32,
+        now: Optional[datetime] = None,
+        dispatch_factor: float = 2.0,
+    ) -> None:
+        self.mesh = mesh
+        n = mesh.devices.size
+        if batch_size % n:
+            raise ValueError(f"batch_size {batch_size} must divide over {n} devices")
+        self.dedup = ShardedDedup(
+            mesh,
+            capacity=capacity,
+            base_hour=base_hour,
+            max_probes=max_probes,
+            dispatch_factor=dispatch_factor,
+        )
+        super().__init__(
+            capacity=capacity,
+            batch_size=batch_size,
+            base_hour=base_hour,
+            cn_prefixes=cn_prefixes,
+            max_probes=max_probes,
+            now=now,
+        )
+
+    # -- hooks -----------------------------------------------------------
+    def _make_table(self, capacity: int):
+        return None  # state lives in self.dedup (sharded over the mesh)
+
+    def _drain_table(self) -> tuple[np.ndarray, np.ndarray]:
+        return self.dedup.drain_np()
+
+    def _device_step(self, device_entries):
+        batch = packing.pack_entries(device_entries, batch_size=self.batch_size)
+        out = self.dedup.step(
+            np.asarray(batch.data),
+            np.asarray(batch.length),
+            np.asarray(batch.issuer_idx),
+            np.asarray(batch.valid),
+            now_hour=self._now_hour(),
+            cn_prefixes=self._prefix_arr,
+            cn_prefix_lens=self._prefix_lens,
+        )
+        return out, batch
+
+    # -- checkpoint ------------------------------------------------------
+    def save_checkpoint(self, path: str) -> None:
+        import jax.numpy as jnp
+
+        from ct_mapreduce_tpu.ops import hashtable
+
+        # Gather the sharded table to host once, reuse the parent format.
+        self.table = hashtable.TableState(
+            keys=jnp.asarray(np.asarray(self.dedup.keys)),
+            meta=jnp.asarray(np.asarray(self.dedup.meta)),
+            count=jnp.asarray(np.asarray(self.dedup.count)),
+        )
+        try:
+            super().save_checkpoint(path)
+        finally:
+            self.table = None
+
+    def load_checkpoint(self, path: str) -> None:
+        super().load_checkpoint(path)
+        # Restore by REINSERTION, not raw row copy: a checkpoint may come
+        # from a different topology (single chip, another mesh size), and
+        # both a key's home shard and its probe sequence depend on the
+        # topology — only re-hashing every occupied row is always correct.
+        keys_np = np.asarray(self.table.keys)
+        meta_np = np.asarray(self.table.meta)
+        occ = keys_np.any(axis=-1)
+        ckpt_cap = int(keys_np.shape[0])
+        target_cap = max(self.dedup.capacity, ckpt_cap)
+        self.dedup = ShardedDedup(
+            self.mesh,
+            capacity=self._mesh_capacity(target_cap),
+            base_hour=self.base_hour,
+            max_probes=self.max_probes,
+            dispatch_factor=self.dedup.dispatch_factor,
+        )
+        overflow = self.dedup.bulk_insert_np(keys_np[occ], meta_np[occ])
+        if overflow:
+            raise RuntimeError(
+                f"checkpoint restore overflowed {overflow} rows; "
+                f"increase tableBits (capacity {self.dedup.capacity})"
+            )
+        self.capacity = self.dedup.capacity
+        self.table = None
+
+    def _mesh_capacity(self, capacity: int) -> int:
+        """Round capacity so each shard gets a power-of-two slice."""
+        n = self.mesh.devices.size
+        per = max(1, -(-capacity // n))  # ceil
+        per_pow2 = 1 << (per - 1).bit_length()
+        return n * per_pow2

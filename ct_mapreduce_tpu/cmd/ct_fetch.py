@@ -13,13 +13,10 @@ per-entry Redis round-trips; device aggregates snapshot to
 
 from __future__ import annotations
 
-import os
 import signal
 import sys
 import threading
 import time
-from datetime import datetime, timezone
-
 from ct_mapreduce_tpu.config import CTConfig
 from ct_mapreduce_tpu.engine import get_configured_storage, prepare_telemetry
 from ct_mapreduce_tpu.ingest.health import HealthServer
@@ -74,20 +71,14 @@ class ProgressPrinter:
 
 def build_sink(config: CTConfig, database):
     """Pick the store path: per-entry host store (reference parity) or
-    the batched device pipeline."""
+    the batched device pipeline (single-chip or mesh-sharded per
+    meshShape — see models.build_aggregator)."""
     if config.backend == "tpu":
-        from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+        from ct_mapreduce_tpu.models import IngestModel
 
-        agg = TpuAggregator(
-            capacity=1 << config.table_bits,
-            batch_size=config.batch_size,
-            cn_prefixes=tuple(config.issuer_cn_filters()),
-            now=(datetime.fromtimestamp(0, tz=timezone.utc)
-                 if config.log_expired_entries else None),
-        )
-        if config.agg_state_path and os.path.exists(config.agg_state_path):
-            agg.load_checkpoint(config.agg_state_path)
-        return AggregatorSink(agg, flush_size=config.batch_size), agg
+        model = IngestModel.from_config(config)
+        return AggregatorSink(model.aggregator,
+                              flush_size=config.batch_size), model
     sink = DatabaseSink(
         database,
         cn_filters=tuple(config.issuer_cn_filters()),
@@ -113,7 +104,12 @@ def main(argv: list[str] | None = None) -> int:
         print(f"IssuerCNFilter enabled: {config.issuer_cn_filters()}",
               file=sys.stderr)
 
-    sink, agg = build_sink(config, database)
+    sink, model = build_sink(config, database)
+    checkpoint_hook = None
+    if model is not None and config.agg_state_path:
+        # Snapshot device aggregates before every durable cursor write —
+        # a crash must never leave the cursor ahead of aggregate state.
+        checkpoint_hook = lambda: sink.checkpointed_save(model.save)  # noqa: E731
     engine = LogSyncEngine(
         sink,
         database,
@@ -121,6 +117,7 @@ def main(argv: list[str] | None = None) -> int:
         offset=config.offset,
         limit=config.limit,
         save_period_s=parse_duration(config.save_period),
+        checkpoint_hook=checkpoint_hook,
     )
     engine.start_store_threads()
 
@@ -158,8 +155,8 @@ def main(argv: list[str] | None = None) -> int:
                 engine.sync_log(url)
             engine.wait_for_downloads()
             engine.stop()  # drain queue, flush sink
-            if agg is not None and config.agg_state_path:
-                agg.save_checkpoint(config.agg_state_path)
+            if model is not None:
+                model.save()
             # Drain this round's errors so runForever doesn't re-print
             # (or unboundedly accumulate) them across polls.
             final_round_errors = bool(engine.errors)

@@ -19,23 +19,6 @@ from ct_mapreduce_tpu.config import CTConfig
 from ct_mapreduce_tpu.engine import get_configured_storage, prepare_telemetry
 
 
-def _verbosity(argv: list[str] | None) -> int:
-    args = argv if argv is not None else sys.argv[1:]
-    v = 0
-    for i, a in enumerate(args):
-        if a in ("-v", "--v") and i + 1 < len(args):
-            try:
-                v = int(args[i + 1])
-            except ValueError:
-                pass
-        elif a.startswith(("-v=", "--v=")):
-            try:
-                v = int(a.split("=", 1)[1])
-            except ValueError:
-                pass
-    return v
-
-
 def report_from_tpu_snapshot(config: CTConfig, out, verbosity: int = 0) -> int:
     """Drain path: aggregate snapshot → the same report shape."""
     import os
@@ -136,9 +119,11 @@ def report_from_database(config: CTConfig, out, verbosity: int = 0) -> int:
         file=out,
     )
 
+    # Headers print unconditionally; the URL walk is gated on the
+    # reference's string-length quirk (storage-statistics.go:86-90).
+    print("", file=out)
+    print("Log status:", file=out)
     if config.log_url_list and len(config.log_url_list) > 5:
-        print("", file=out)
-        print("Log status:", file=out)
         for url in config.log_urls():
             from ct_mapreduce_tpu.ingest.ctclient import short_url
 
@@ -150,10 +135,9 @@ def report_from_database(config: CTConfig, out, verbosity: int = 0) -> int:
 def main(argv: list[str] | None = None) -> int:
     config = CTConfig.load(argv)
     prepare_telemetry("storage-statistics", config)
-    verbosity = _verbosity(argv)
     if config.backend == "tpu":
-        return report_from_tpu_snapshot(config, sys.stdout, verbosity)
-    return report_from_database(config, sys.stdout, verbosity)
+        return report_from_tpu_snapshot(config, sys.stdout, config.verbosity)
+    return report_from_database(config, sys.stdout, config.verbosity)
 
 
 if __name__ == "__main__":

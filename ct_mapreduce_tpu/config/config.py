@@ -53,6 +53,7 @@ class CTConfig:
     mesh_shape: str = ""  # e.g. "data:4,expert:2"; empty = all devices on data
     device_queue_depth: int = 2
     agg_state_path: str = ""  # .npz snapshot of device aggregates (tpu backend)
+    verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
         # directive name -> (field, type)
@@ -157,6 +158,7 @@ class CTConfig:
             cfg.nobars = True
         if getattr(args, "backend", None):
             cfg.backend = args.backend
+        cfg.verbosity = args.v
         return cfg
 
     @staticmethod
@@ -177,6 +179,10 @@ class CTConfig:
             "--backend",
             default="",
             help="storage execution path: noop | localdisk | redis | tpu",
+        )
+        p.add_argument(
+            "-v", "--v", type=int, default=0,
+            help="verbosity level (glog-style)",
         )
         return p
 

@@ -144,6 +144,17 @@ class AggregatorSink:
         if batch:
             self._dispatch(batch)
 
+    def checkpointed_save(self, save_fn) -> None:
+        """Flush pending entries, then run ``save_fn`` while holding the
+        dispatch lock — so snapshots never observe a mid-step (donated)
+        table. Used as the engine's pre-cursor-save hook: aggregate
+        state must be durable BEFORE the log cursor advances past the
+        entries it contains (the reference gets this for free because
+        every Redis write is durable per entry)."""
+        self.flush()
+        with self._dispatch_lock:
+            save_fn()
+
     def _dispatch(self, batch: list[tuple[bytes, bytes]]) -> None:
         # The aggregator's table state is donated between steps; concurrent
         # ingest calls would race on a deleted buffer.
@@ -175,9 +186,11 @@ class LogWorker:
         database,
         offset: int = 0,
         limit: int = 0,
+        pre_save=None,
     ):
         self.client = client
         self.database = database
+        self.pre_save = pre_save  # runs before each durable cursor write
         self.sth = client.get_sth()
         self.log_state: CertificateLog = database.get_log_state(client.short_url)
         if offset > 0:
@@ -194,7 +207,11 @@ class LogWorker:
 
     def save_state(self) -> None:
         """Persist the cursor (ct-fetch.go:371-392): dual-written by
-        the database facade (cache + backend)."""
+        the database facade (cache + backend). ``pre_save`` (e.g. the
+        aggregate snapshot) must succeed first — a cursor must never
+        durably advance past entries whose aggregation isn't durable."""
+        if self.pre_save is not None:
+            self.pre_save()
         self.log_state.max_entry = self.position
         if self.last_entry_time is not None:
             self.log_state.last_entry_time = self.last_entry_time
@@ -292,9 +309,14 @@ class LogSyncEngine:
         offset: int = 0,
         limit: int = 0,
         save_period_s: float = 900.0,
+        checkpoint_hook=None,
     ):
         self.sink = sink
         self.database = database
+        # Runs before each durable cursor write (after the queue drains):
+        # in TPU mode this snapshots the device aggregates so the cursor
+        # never outruns durable aggregate state.
+        self.checkpoint_hook = checkpoint_hook
         self.num_threads = num_threads
         self.offset = offset
         self.limit = limit
@@ -351,13 +373,21 @@ class LogSyncEngine:
             t.start()
             self._store_threads.append(t)
 
+    def _pre_cursor_save(self) -> None:
+        """Make everything the cursor covers durable: wait out the
+        queue (enqueued ⇒ stored), then run the checkpoint hook."""
+        self.entry_queue.join()
+        if self.checkpoint_hook is not None:
+            self.checkpoint_hook()
+
     # -- producers ------------------------------------------------------
     def sync_log(self, log_url: str, transport=None) -> threading.Thread:
         def run() -> None:
             try:
                 client = CTLogClient(log_url, transport=transport)
                 worker = LogWorker(
-                    client, self.database, offset=self.offset, limit=self.limit
+                    client, self.database, offset=self.offset, limit=self.limit,
+                    pre_save=self._pre_cursor_save,
                 )
                 self._note_progress(client.short_url, worker.position, worker.end_pos)
                 worker.run(
@@ -381,6 +411,9 @@ class LogSyncEngine:
         for t in self._download_threads:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
             t.join(remaining)
+        # Drop finished threads so runForever rounds don't accumulate
+        # (and re-join) an ever-growing history.
+        self._download_threads = [t for t in self._download_threads if t.is_alive()]
 
     def stop(self) -> None:
         """Drain and terminate the store workers (ct-fetch.go:167-171)."""

@@ -1,0 +1,5 @@
+"""The flagship end-to-end pipeline ("model") assembled from config."""
+
+from ct_mapreduce_tpu.models.ingest_model import IngestModel, build_aggregator
+
+__all__ = ["IngestModel", "build_aggregator"]

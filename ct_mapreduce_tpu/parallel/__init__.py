@@ -1,0 +1,32 @@
+"""Mesh construction, shardings, and multi-host initialization.
+
+The scale-out fabric of the framework: where the reference coordinates
+many processes through Redis (/root/reference/coordinator/coordinator.go)
+and serializes all shared state through one cache, this layer places
+work on a ``jax.sharding.Mesh`` — batches sharded along the batch axis,
+reduce state sharded by key — with XLA collectives over ICI doing the
+communication, and ``jax.distributed`` + host-0 leadership replacing
+the Redis election for multi-host runs.
+"""
+
+from ct_mapreduce_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    parse_mesh_shape,
+)
+from ct_mapreduce_tpu.parallel.distributed import (
+    DistributedCoordinator,
+    device_barrier,
+    initialize_multihost,
+    is_leader,
+)
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "parse_mesh_shape",
+    "DistributedCoordinator",
+    "device_barrier",
+    "initialize_multihost",
+    "is_leader",
+]

@@ -1,0 +1,129 @@
+"""Multi-host initialization and TPU-native coordination.
+
+The reference's scale-out control plane is Redis SETNX leader election
+plus a polled start barrier (/root/reference/coordinator/
+coordinator.go:44-138). The TPU-native equivalent (SURVEY.md §2.3 role
+2):
+
+- ``initialize_multihost`` wraps ``jax.distributed.initialize`` — the
+  JAX runtime's coordination service IS the election (process 0 hosts
+  the coordinator, everyone else connects to it over DCN);
+- leadership is ``process_index == 0`` — deterministic, no contention,
+  renewed implicitly by the runtime's health checks rather than a
+  lease-renewal thread;
+- the start barrier is a collective: an all-reduce over every
+  addressable device rides ICI/DCN and unblocks all hosts at once,
+  instead of followers polling Redis every 250 ms.
+
+:class:`DistributedCoordinator` exposes the reference Coordinator's
+interface (await_leader / await_start / send_start) on top of these so
+callers can swap fabrics by construction alone.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up jax.distributed. No-ops when already initialized or
+    when running single-process with no arguments (the common
+    single-host case needs no coordination service)."""
+    import jax
+
+    if jax.process_count() > 1 or _already_initialized():
+        return
+    if coordinator_address is None and num_processes is None:
+        # Single-process: TPU pod env vars (when present) let
+        # jax.distributed.initialize() autodetect; otherwise stay local.
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def _already_initialized() -> bool:
+    from jax._src import distributed
+
+    return distributed.global_state.client is not None
+
+
+def is_leader() -> bool:
+    """Host-0 leadership — the fixed, contention-free analog of winning
+    the SETNX election."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def device_barrier(tag: str = "barrier") -> None:
+    """Block until every process reaches the barrier: a 1-element
+    psum over all devices forces a synchronizing collective."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import numpy as np
+
+    devices = np.asarray(jax.devices())
+    mesh = Mesh(devices, ("all",))
+
+    @jax.jit
+    def _reduce(x):
+        return jax.shard_map(
+            lambda v: jax.lax.psum(v, "all"),
+            mesh=mesh,
+            in_specs=P("all"),
+            out_specs=P(),
+        )(x)
+
+    x = jax.device_put(
+        jnp.ones((devices.size,), jnp.int32), NamedSharding(mesh, P("all"))
+    )
+    total = int(_reduce(x)[0])  # local slice is [1]; psum → replicated [1]
+    if total != devices.size:
+        raise RuntimeError(f"barrier psum returned {total} != {devices.size}")
+
+
+class DistributedCoordinator:
+    """Reference-Coordinator interface over jax.distributed.
+
+    await_leader: returns host-0 status (no contention to win).
+    send_start / await_start: both sides enter the device barrier — the
+    leader's entry releases the followers, like publishing
+    ``started-<id>`` does in the Redis protocol.
+    """
+
+    def __init__(self, name: str = "ct-fetch"):
+        self.name = name
+        self.is_leader = False
+        self.identifier = ""
+
+    def await_leader(self) -> bool:
+        import jax
+
+        self.is_leader = is_leader()
+        self.identifier = f"jax-process-{jax.process_index()}"
+        return self.is_leader
+
+    def await_start(self, timeout_s: Optional[float] = None) -> None:
+        if not self.identifier:
+            raise RuntimeError("Must not call before await_leader completes")
+        if self.is_leader:
+            raise RuntimeError("Must not call unless we're a follower")
+        device_barrier(f"start-{self.name}")
+
+    def send_start(self) -> None:
+        if not self.identifier:
+            raise RuntimeError("Must not call before await_leader completes")
+        if not self.is_leader:
+            raise RuntimeError("Must not call unless we're leader")
+        device_barrier(f"start-{self.name}")
+
+    def close(self) -> None:
+        pass

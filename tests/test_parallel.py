@@ -1,0 +1,226 @@
+"""parallel/ mesh + distributed helpers, ShardedAggregator parity, and
+the config→model composition root (models.build_aggregator)."""
+
+import datetime
+
+import jax
+import numpy as np
+import pytest
+
+from ct_mapreduce_tpu.config import CTConfig
+from ct_mapreduce_tpu.parallel import (
+    DistributedCoordinator,
+    device_barrier,
+    is_leader,
+    make_mesh,
+    parse_mesh_shape,
+)
+
+from tests import certgen
+
+UTC = datetime.timezone.utc
+FUTURE = datetime.datetime(2031, 6, 15, tzinfo=UTC)
+NOW = datetime.datetime(2025, 1, 1, tzinfo=UTC)
+
+
+# -- mesh spec --------------------------------------------------------------
+
+
+def test_parse_mesh_shape_default():
+    spec = parse_mesh_shape("")
+    assert spec.axis_names == ("shard",)
+    assert spec.resolve(8) == (8,)
+
+
+def test_parse_mesh_shape_named():
+    spec = parse_mesh_shape("data:4,expert:2")
+    assert spec.axis_names == ("data", "expert")
+    assert spec.resolve(8) == (4, 2)
+    assert spec.resolve(64) == (4, 2)  # extra devices unused
+
+
+def test_parse_mesh_shape_wildcard():
+    spec = parse_mesh_shape("data:2,rest:-1")
+    assert spec.resolve(8) == (2, 4)
+
+
+def test_parse_mesh_shape_errors():
+    with pytest.raises(ValueError):
+        parse_mesh_shape("data=4")
+    with pytest.raises(ValueError):
+        parse_mesh_shape("a:2,a:2")
+    with pytest.raises(ValueError):
+        parse_mesh_shape("a:-1,b:-1").resolve(8)
+    with pytest.raises(ValueError):
+        parse_mesh_shape("a:16").resolve(8)
+
+
+def test_make_mesh():
+    mesh = make_mesh("")
+    assert mesh.devices.size == len(jax.devices())
+    mesh2 = make_mesh("data:2,expert:4")
+    assert mesh2.devices.shape == (2, 4)
+    assert mesh2.axis_names == ("data", "expert")
+
+
+# -- distributed helpers (single-process semantics) -------------------------
+
+
+def test_leader_and_barrier():
+    assert is_leader()  # process_index 0 in single-process runs
+    device_barrier("test")
+
+
+def test_distributed_coordinator_protocol():
+    c = DistributedCoordinator("t")
+    with pytest.raises(RuntimeError):
+        c.send_start()
+    assert c.await_leader() is True
+    c.send_start()
+    with pytest.raises(RuntimeError):
+        c.await_start()  # leaders must not await
+    c.close()
+
+
+# -- ShardedAggregator parity ----------------------------------------------
+
+
+def _entries(n_issuers=2, per=6, dupes=2):
+    out = []
+    for i in range(n_issuers):
+        cn = f"Shard CA {i}"
+        issuer = certgen.make_cert(serial=1, issuer_cn=cn, is_ca=True,
+                                   not_after=FUTURE, key_seed=i)
+        uniq = per - dupes
+        for s in range(per):
+            leaf = certgen.make_cert(
+                serial=5000 + (s % uniq), issuer_cn=cn,
+                subject_cn="s.example.com", is_ca=False,
+                not_after=FUTURE, key_seed=i,
+                crl_dps=(f"http://crl{i}.example/x.crl",),
+            )
+            out.append((leaf, issuer))
+    return out
+
+
+def test_sharded_aggregator_matches_single_chip():
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
+
+    entries = _entries()
+    mesh = make_mesh("")
+    sharded = ShardedAggregator(mesh, capacity=1 << 12, batch_size=32, now=NOW)
+    single = TpuAggregator(capacity=1 << 12, batch_size=32, now=NOW)
+
+    r_sh = sharded.ingest(entries)
+    r_si = single.ingest(entries)
+    np.testing.assert_array_equal(r_sh.was_unknown, r_si.was_unknown)
+    np.testing.assert_array_equal(r_sh.filtered, r_si.filtered)
+
+    snap_sh, snap_si = sharded.drain(), single.drain()
+    assert snap_sh.counts == snap_si.counts
+    assert snap_sh.crls == snap_si.crls
+    assert snap_sh.dns == snap_si.dns
+    assert snap_sh.total == snap_si.total == 8  # 2 issuers × 4 unique
+
+
+def test_sharded_aggregator_checkpoint_roundtrip(tmp_path):
+    from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
+
+    entries = _entries(n_issuers=1)
+    mesh = make_mesh("")
+    agg = ShardedAggregator(mesh, capacity=1 << 12, batch_size=32, now=NOW)
+    agg.ingest(entries)
+    before = agg.drain()
+    path = str(tmp_path / "sharded.npz")
+    agg.save_checkpoint(path)
+
+    agg2 = ShardedAggregator(mesh, capacity=1 << 12, batch_size=32, now=NOW)
+    agg2.load_checkpoint(path)
+    assert agg2.drain().counts == before.counts
+    # Replaying the same entries after restore finds nothing new.
+    r = agg2.ingest(entries)
+    assert int(np.asarray(r.was_unknown).sum()) == 0
+
+
+def test_cross_topology_restore_single_to_sharded(tmp_path):
+    """A single-chip checkpoint restores onto a mesh by reinsertion —
+    home shards and probe sequences are topology-dependent, so raw row
+    copies would lose keys."""
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
+
+    entries = _entries(n_issuers=2)
+    single = TpuAggregator(capacity=1 << 12, batch_size=32, now=NOW)
+    single.ingest(entries)
+    before = single.drain()
+    path = str(tmp_path / "single.npz")
+    single.save_checkpoint(path)
+
+    mesh = make_mesh("")
+    sharded = ShardedAggregator(mesh, capacity=1 << 12, batch_size=32, now=NOW)
+    sharded.load_checkpoint(path)
+    assert sharded.drain().counts == before.counts
+    r = sharded.ingest(entries)  # replay: everything already known
+    assert int(np.asarray(r.was_unknown).sum()) == 0
+
+
+def test_pre_save_hook_ordering():
+    """The engine's checkpoint hook must run before the durable cursor
+    write (aggregate durability precedes cursor advance)."""
+    from ct_mapreduce_tpu.ingest.ctclient import CTLogClient
+    from ct_mapreduce_tpu.ingest.sync import LogWorker
+    from ct_mapreduce_tpu.storage.certdb import FilesystemDatabase
+    from ct_mapreduce_tpu.storage.mockbackend import MockBackend
+    from ct_mapreduce_tpu.storage.mockcache import MockRemoteCache
+
+    from tests.fakelog import FakeLog
+
+    log = FakeLog()
+    issuer = certgen.make_cert(serial=1, issuer_cn="Hook CA", is_ca=True,
+                               not_after=FUTURE)
+    leaf = certgen.make_cert(serial=2, issuer_cn="Hook CA", is_ca=False,
+                             not_after=FUTURE)
+    log.add_cert(leaf, issuer)
+
+    calls = []
+    db = FilesystemDatabase(MockBackend(), MockRemoteCache())
+    orig = db.save_log_state
+    db.save_log_state = lambda s: (calls.append("cursor"), orig(s))[1]
+    client = CTLogClient(log.url, transport=log.transport)
+    w = LogWorker(client, db, pre_save=lambda: calls.append("snapshot"))
+    w.position = 1
+    w.save_state()
+    assert calls == ["snapshot", "cursor"]
+
+
+# -- composition root -------------------------------------------------------
+
+
+def test_build_aggregator_selects_path():
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
+    from ct_mapreduce_tpu.models import build_aggregator
+
+    cfg = CTConfig(table_bits=12, batch_size=64)
+    agg = build_aggregator(cfg)  # 8 virtual devices → sharded
+    assert isinstance(agg, ShardedAggregator)
+    assert agg.dedup.n_shards == len(jax.devices())
+
+    cfg1 = CTConfig(table_bits=12, batch_size=64, mesh_shape="shard:1")
+    assert isinstance(build_aggregator(cfg1), TpuAggregator)
+    assert not isinstance(build_aggregator(cfg1), ShardedAggregator)
+
+
+def test_ingest_model_from_config(tmp_path):
+    from ct_mapreduce_tpu.models import IngestModel
+
+    state = tmp_path / "m.npz"
+    cfg = CTConfig(table_bits=12, batch_size=64, agg_state_path=str(state))
+    model = IngestModel.from_config(cfg)
+    model.ingest(_entries(n_issuers=1))
+    model.save()
+    assert state.exists()
+
+    model2 = IngestModel.from_config(cfg)
+    assert model2.drain().total == model.drain().total == 4
