@@ -52,9 +52,17 @@ def main() -> int:
     batch = int(os.environ.get("CT_BENCH_BATCH", "16384"))
     n_batches = int(os.environ.get("CT_BENCH_RESIDENT", "8"))
     pad_len = int(os.environ.get("CT_BENCH_PADLEN", "1024"))
-    capacity = 1 << int(os.environ.get("CT_BENCH_LOG2_CAPACITY", "23"))
+    capacity = 1 << int(os.environ.get("CT_BENCH_LOG2_CAPACITY", "26"))
     target_secs = float(os.environ.get("CT_BENCH_SECS", "2.0"))
-    max_sweeps = int(os.environ.get("CT_BENCH_MAX_SWEEPS", "30"))
+    max_sweeps = int(os.environ.get("CT_BENCH_MAX_SWEEPS", "240"))
+
+    # All-fresh inserts fill the table; keep the worst-case load factor
+    # bounded so probe behavior stays representative.
+    max_entries = (max_sweeps + 1) * n_batches * batch
+    if max_entries > capacity * 0.6:
+        log(f"capacity {capacity} too small for {max_entries} unique "
+            f"entries; raise CT_BENCH_LOG2_CAPACITY or lower sweeps")
+        return 1
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind}); batch={batch} "
@@ -75,12 +83,15 @@ def main() -> int:
         )
     issuer_idx = jax.device_put(np.zeros((batch,), np.int32))
     valid = jax.device_put(np.ones((batch,), bool))
-    cn_prefixes = jnp.zeros((0, 32), jnp.uint8)
-    cn_prefix_lens = jnp.zeros((0,), jnp.int32)
     epoch_cols = tpl.serial_off + np.arange(4, 8, dtype=np.int32)
 
+    # CRITICAL (axon/PJRT): every device array must be an ARGUMENT.
+    # A jitted program that closes over a committed device buffer — even
+    # a scalar — permanently degrades all subsequent dispatches on this
+    # stack to a ~70 ms synchronous path (measured; see PROGRESS notes).
+    # numpy closures (epoch_cols) lower to HLO literals and are fine.
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def bench_step(table, data, length, epoch):
+    def bench_step(table, data, length, issuer_idx, valid, epoch):
         # Unique serials per epoch: write the epoch uint32 into serial
         # bytes 4..8 (lane counter already occupies bytes 8..16).
         e = epoch.astype(jnp.uint32)
@@ -91,7 +102,7 @@ def main() -> int:
         table, out = pipeline.ingest_core(
             table, data, length, issuer_idx, valid,
             jnp.int32(now_hour), jnp.int32(packing.DEFAULT_BASE_HOUR),
-            cn_prefixes, cn_prefix_lens,
+            jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0,), jnp.int32),
         )
         # Only the table and cheap scalars leave the step: keep the
         # benchmark output-bound on compute, not D2H.
@@ -102,7 +113,8 @@ def main() -> int:
     # Warmup sweep: compiles and inserts epoch-0 serials.
     t0 = time.perf_counter()
     for data, lengths in dev_batches:
-        table, f, h = bench_step(table, data, lengths, jnp.uint32(0))
+        table, f, h = bench_step(table, data, lengths, issuer_idx, valid,
+                                 jnp.uint32(0))
     f.block_until_ready()
     log(f"warmup (compile + first sweep): {time.perf_counter() - t0:.1f}s")
     warm_entries = n_batches * batch
@@ -115,7 +127,8 @@ def main() -> int:
     while sweep < max_sweeps:
         sweep += 1
         for data, lengths in dev_batches:
-            table, f, h = bench_step(table, data, lengths, jnp.uint32(sweep))
+            table, f, h = bench_step(table, data, lengths, issuer_idx,
+                                     valid, jnp.uint32(sweep))
             fresh_totals.append((f, h))
         processed += n_batches * batch
         if sweep >= 3 and time.perf_counter() - t0 >= target_secs:

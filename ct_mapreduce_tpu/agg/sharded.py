@@ -45,6 +45,13 @@ from ct_mapreduce_tpu.ops import hashtable, pipeline
 AXIS = "shard"
 
 
+def mesh_capacity(n_shards: int, capacity: int) -> int:
+    """Smallest capacity ≥ ``capacity`` that divides over ``n_shards``
+    with a power-of-two per-shard slice (the probe mask requirement)."""
+    per = max(1, -(-capacity // n_shards))  # ceil
+    return n_shards * (1 << (per - 1).bit_length())
+
+
 class ShardedStepOut(NamedTuple):
     was_unknown: jax.Array  # bool[B]
     host_lane: jax.Array  # bool[B] (parse/serial/meta/probe/dispatch overflow)
@@ -109,6 +116,7 @@ def _local_step(
     data, length, issuer_idx, valid,
     now_hour, base_hour, cn_prefixes, cn_prefix_lens,
     *, n_shards: int, cap: int, num_issuers: int, max_probes: int,
+    axis: str = AXIS,
 ):
     """Per-device body, run under shard_map over the 1-D mesh."""
     # --- stage 1: local parse / filter / fingerprint (pure DP) ----------
@@ -128,9 +136,9 @@ def _local_step(
     )
     dispatch_dropped = lanes.insertable & (slot_of_lane < 0)
 
-    recv = jax.lax.all_to_all(send, AXIS, split_axis=0, concat_axis=0, tiled=True)
+    recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=True)
     recv_valid = jax.lax.all_to_all(
-        send_valid, AXIS, split_axis=0, concat_axis=0, tiled=True
+        send_valid, axis, split_axis=0, concat_axis=0, tiled=True
     )
 
     # --- stage 3: local insert ------------------------------------------
@@ -147,13 +155,13 @@ def _local_step(
     local_counts = jnp.zeros((num_issuers,), jnp.int32).at[r_issuer].add(
         r_unknown.astype(jnp.int32), mode="drop"
     )
-    issuer_counts = jax.lax.psum(local_counts, AXIS)
+    issuer_counts = jax.lax.psum(local_counts, axis)
 
     # --- stage 4: route results home (1 word: unknown | overflow<<1) ----
     back = (
         r_unknown.astype(jnp.uint32) | (r_overflow.astype(jnp.uint32) << 1)
     ).reshape(n_shards, cap, 1)
-    back = jax.lax.all_to_all(back, AXIS, split_axis=0, concat_axis=0, tiled=True)
+    back = jax.lax.all_to_all(back, axis, split_axis=0, concat_axis=0, tiled=True)
     back = back.reshape(n_shards * cap)
 
     flat_slot = jnp.where(slot_of_lane >= 0, slot_of_lane, 0)
@@ -207,7 +215,13 @@ class ShardedDedup:
         max_probes: int = 32,
         dispatch_factor: float = 2.0,
     ) -> None:
+        if len(mesh.axis_names) != 1:
+            raise ValueError(
+                f"ShardedDedup needs a 1-D mesh, got axes {mesh.axis_names}; "
+                "flatten the mesh first (models.build_aggregator does this)"
+            )
         self.mesh = mesh
+        self.axis = mesh.axis_names[0]
         self.n_shards = mesh.devices.size
         if capacity % self.n_shards:
             raise ValueError("capacity must divide evenly across the mesh")
@@ -222,7 +236,7 @@ class ShardedDedup:
         self.max_probes = max_probes
         self.dispatch_factor = dispatch_factor
 
-        row_sharded = NamedSharding(mesh, P(AXIS))
+        row_sharded = NamedSharding(mesh, P(self.axis))
         self.keys = jax.device_put(
             jnp.zeros((capacity, 4), jnp.uint32), row_sharded
         )
@@ -252,25 +266,27 @@ class ShardedDedup:
             cap=cap,
             num_issuers=self.num_issuers,
             max_probes=self.max_probes,
+            axis=self.axis,
         )
+        A = P(self.axis)
         mapped = jax.shard_map(
             local,
             mesh=self.mesh,
             in_specs=(
-                P(AXIS), P(AXIS), P(AXIS),  # table keys/meta/count
-                P(AXIS), P(AXIS), P(AXIS), P(AXIS),  # batch
+                A, A, A,  # table keys/meta/count
+                A, A, A, A,  # batch
                 P(), P(), P(), P(),  # scalars + prefixes (replicated)
             ),
             out_specs=(
-                P(AXIS), P(AXIS), P(AXIS),
+                A, A, A,
                 ShardedStepOut(
-                    was_unknown=P(AXIS), host_lane=P(AXIS),
-                    filtered_ca=P(AXIS), filtered_expired=P(AXIS),
-                    filtered_cn=P(AXIS), not_after_hour=P(AXIS),
-                    serials=P(AXIS), serial_len=P(AXIS),
+                    was_unknown=A, host_lane=A,
+                    filtered_ca=A, filtered_expired=A,
+                    filtered_cn=A, not_after_hour=A,
+                    serials=A, serial_len=A,
                     issuer_unknown_counts=P(),
-                    has_crldp=P(AXIS), crldp_off=P(AXIS), crldp_len=P(AXIS),
-                    issuer_name_off=P(AXIS), issuer_name_len=P(AXIS),
+                    has_crldp=A, crldp_off=A, crldp_len=A,
+                    issuer_name_off=A, issuer_name_len=A,
                 ),
             ),
             check_vma=False,
@@ -294,7 +310,7 @@ class ShardedDedup:
             cn_prefix_lens = np.zeros((0,), np.int32)
         b, l = data.shape
         fn = self._compiled(b, l, cn_prefixes.shape[0], cn_prefixes.shape[1])
-        batch_sharding = NamedSharding(self.mesh, P(AXIS))
+        batch_sharding = NamedSharding(self.mesh, P(self.axis))
         args = [
             jax.device_put(jnp.asarray(x), batch_sharding)
             for x in (data, length, issuer_idx, valid)
@@ -326,8 +342,8 @@ class ShardedDedup:
         mapped = jax.shard_map(
             local,
             mesh=self.mesh,
-            in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
-            out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+            in_specs=tuple([P(self.axis)] * 6),
+            out_specs=tuple([P(self.axis)] * 4),
             check_vma=False,
         )
         fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
@@ -352,7 +368,7 @@ class ShardedDedup:
         per_shard = [np.flatnonzero(dest == i) for i in range(n)]
         max_len = max(idx.size for idx in per_shard)
         overflowed = 0
-        batch_sharding = NamedSharding(self.mesh, P(AXIS))
+        batch_sharding = NamedSharding(self.mesh, P(self.axis))
         for start in range(0, max_len, chunk):
             width = min(chunk, max_len - start)
             send = np.zeros((n, width, 4), np.uint32)

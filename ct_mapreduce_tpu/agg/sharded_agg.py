@@ -120,7 +120,6 @@ class ShardedAggregator(TpuAggregator):
 
     def _mesh_capacity(self, capacity: int) -> int:
         """Round capacity so each shard gets a power-of-two slice."""
-        n = self.mesh.devices.size
-        per = max(1, -(-capacity // n))  # ceil
-        per_pow2 = 1 << (per - 1).bit_length()
-        return n * per_pow2
+        from ct_mapreduce_tpu.agg.sharded import mesh_capacity
+
+        return mesh_capacity(self.mesh.devices.size, capacity)

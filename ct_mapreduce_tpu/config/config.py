@@ -181,7 +181,10 @@ class CTConfig:
             help="storage execution path: noop | localdisk | redis | tpu",
         )
         p.add_argument(
-            "-v", "--v", type=int, default=0,
+            "-v", "--v",
+            # glog-style "-v=2" arrives from argparse as "=2" — accept it.
+            type=lambda s: int(s.lstrip("=")),
+            default=0,
             help="verbosity level (glog-style)",
         )
         return p

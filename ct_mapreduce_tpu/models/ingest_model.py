@@ -42,15 +42,19 @@ def build_aggregator(config: CTConfig, mesh=None) -> TpuAggregator:
         if n_fixed > 1:
             mesh = make_mesh(spec)
     if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import Mesh
+
+        from ct_mapreduce_tpu.agg.sharded import AXIS, mesh_capacity
         from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
 
-        # Per-shard capacity must stay a power of two, and the batch
-        # must divide across the mesh — round both up.
+        # The dedup's table/batch sharding is 1-D; flatten multi-axis
+        # meshes (e.g. "data:4,expert:2") over the same devices.
+        if len(mesh.axis_names) != 1:
+            mesh = Mesh(mesh.devices.reshape(-1), (AXIS,))
         n = mesh.devices.size
-        cap = 1 << config.table_bits
-        if cap % n:
-            per = 1 << max(1, config.table_bits - (n - 1).bit_length())
-            cap = n * per
+        # Round capacity UP to a power-of-two-per-shard multiple, and
+        # the batch up to a multiple of the mesh size.
+        cap = mesh_capacity(n, 1 << config.table_bits)
         batch = -(-common["batch_size"] // n) * n
         return ShardedAggregator(
             mesh, **{**common, "capacity": cap, "batch_size": batch}

@@ -25,21 +25,39 @@ from __future__ import annotations
 from typing import Optional
 
 
+# Env vars whose presence signals a multi-host environment where
+# argument-less jax.distributed.initialize() can autodetect peers.
+_AUTODETECT_ENV = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "TPU_WORKER_HOSTNAMES",
+)
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
 ) -> None:
-    """Bring up jax.distributed. No-ops when already initialized or
-    when running single-process with no arguments (the common
-    single-host case needs no coordination service)."""
+    """Bring up jax.distributed.
+
+    Must run before any JAX computation (jax.distributed's own
+    contract) — so this deliberately avoids jax.process_count() or any
+    other backend-initializing call before initialize(). With explicit
+    arguments it initializes directly; with none, it autodetects iff a
+    multi-host environment variable is present, else stays local.
+    No-ops when the distributed client already exists."""
+    import os
+
     import jax
 
-    if jax.process_count() > 1 or _already_initialized():
+    if _already_initialized():
         return
     if coordinator_address is None and num_processes is None:
-        # Single-process: TPU pod env vars (when present) let
-        # jax.distributed.initialize() autodetect; otherwise stay local.
+        if not any(os.environ.get(k) for k in _AUTODETECT_ENV):
+            return  # single-host: no coordination service needed
+        jax.distributed.initialize()
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -116,7 +134,31 @@ class DistributedCoordinator:
             raise RuntimeError("Must not call before await_leader completes")
         if self.is_leader:
             raise RuntimeError("Must not call unless we're a follower")
-        device_barrier(f"start-{self.name}")
+        if timeout_s is None:
+            device_barrier(f"start-{self.name}")
+            return
+        # Collectives have no native timeout; honor the contract by
+        # waiting on a worker thread. On expiry the thread (and its
+        # pending collective) is abandoned — the caller is expected to
+        # treat TimeoutError as fatal for this process, like the
+        # reference's polled barrier timeout.
+        import threading
+
+        err: list[BaseException] = []
+
+        def run():
+            try:
+                device_barrier(f"start-{self.name}")
+            except BaseException as e:  # surfaced to the caller below
+                err.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if t.is_alive():
+            raise TimeoutError("start barrier")
+        if err:
+            raise err[0]
 
     def send_start(self) -> None:
         if not self.identifier:

@@ -212,6 +212,22 @@ def test_build_aggregator_selects_path():
     assert not isinstance(build_aggregator(cfg1), ShardedAggregator)
 
 
+def test_build_aggregator_multi_axis_mesh_flattens():
+    """The config docs' own example ("data:4,expert:2") must not crash:
+    the 1-D dedup flattens multi-axis meshes over the same devices."""
+    from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
+    from ct_mapreduce_tpu.models import build_aggregator
+
+    cfg = CTConfig(table_bits=12, batch_size=60,  # 60 % 8 != 0 → rounds up
+                   mesh_shape="data:4,expert:2")
+    agg = build_aggregator(cfg)
+    assert isinstance(agg, ShardedAggregator)
+    assert agg.dedup.n_shards == 8
+    assert agg.batch_size % 8 == 0
+    # capacity rounded UP, never below the configured size
+    assert agg.dedup.capacity >= (1 << 12)
+
+
 def test_ingest_model_from_config(tmp_path):
     from ct_mapreduce_tpu.models import IngestModel
 
