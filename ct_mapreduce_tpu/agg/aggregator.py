@@ -192,91 +192,164 @@ class TpuAggregator:
         """Process (leaf_der, issuer_der) pairs; any count, chunked
         internally to the device batch size."""
         n = len(entries)
-        was_unknown = np.zeros((n,), bool)
-        filtered = np.zeros((n,), bool)
-        exp_hours = np.zeros((n,), np.int32)
-        serials: list[Optional[bytes]] = [None] * n
-        issuer_idx = np.zeros((n,), np.int32)
-        host_lane_total = 0
-
+        res = IngestResult(
+            was_unknown=np.zeros((n,), bool),
+            filtered=np.zeros((n,), bool),
+            exp_hours=np.zeros((n,), np.int32),
+            serials=[None] * n,
+            issuer_idx=np.zeros((n,), np.int32),
+        )
         for i, (_, issuer_der) in enumerate(entries):
-            issuer_idx[i] = self.registry.get_or_assign(issuer_der)
+            res.issuer_idx[i] = self.registry.get_or_assign(issuer_der)
 
         max_len = packing.LENGTH_BUCKETS[-1]
+        host_lane_total = 0
         for start in range(0, n, self.batch_size):
             chunk = entries[start : start + self.batch_size]
-            idxs = issuer_idx[start : start + len(chunk)]
             device_entries, device_pos, host_pos = [], [], []
             for j, (der, _) in enumerate(chunk):
                 if len(der) <= max_len:
-                    device_entries.append((der, int(idxs[j])))
+                    device_entries.append((der, int(res.issuer_idx[start + j])))
                     device_pos.append(start + j)
                 else:
                     host_pos.append(start + j)
             if device_entries:
-                out, batch = self._device_step(device_entries)
-                hl = np.asarray(out.host_lane)
-                wu = np.asarray(out.was_unknown)
-                nah = np.asarray(out.not_after_hour)
-                slen = np.asarray(out.serial_len)
-                sarr = np.asarray(out.serials)
-                f_any = (
-                    np.asarray(out.filtered_ca)
-                    | np.asarray(out.filtered_expired)
-                    | np.asarray(out.filtered_cn)
+                batch = packing.pack_entries(
+                    device_entries, batch_size=self.batch_size
                 )
-                self.metrics["filtered_ca"] += int(np.asarray(out.filtered_ca).sum())
-                self.metrics["filtered_expired"] += int(
-                    np.asarray(out.filtered_expired).sum()
-                )
-                self.metrics["filtered_cn"] += int(np.asarray(out.filtered_cn).sum())
-                self.issuer_totals += np.asarray(
-                    out.issuer_unknown_counts, dtype=np.int64
-                )
-                for lane, pos in enumerate(device_pos):
-                    if hl[lane]:
-                        host_pos.append(pos)
-                        continue
-                    filtered[pos] = f_any[lane]
-                    if not f_any[lane]:
-                        exp_hours[pos] = nah[lane]
-                        serials[pos] = sarr[lane, : slen[lane]].tobytes()
-                        if wu[lane]:
-                            # Cross-encoding guard (see module docstring).
-                            key = (int(idxs[pos - start]), int(nah[lane]))
-                            if serials[pos] in self.host_serials.get(key, ()):
-                                wu[lane] = False
-                            else:
-                                was_unknown[pos] = True
-                self._accumulate_metadata(batch, out, device_pos, was_unknown)
-                dev_unknown = int(wu.sum())
-                dev_known = len(device_pos) - int(hl.sum()) - dev_unknown
-                self.metrics["inserted"] += dev_unknown
-                self.metrics["known"] += max(dev_known, 0)
-            # Exact host path for flagged + oversized lanes.
-            for pos in host_pos:
-                host_lane_total += 1
-                u, f, eh, sb = self._host_exact(
-                    entries[pos][0], int(issuer_idx[pos])
-                )
-                was_unknown[pos], filtered[pos] = u, f
-                exp_hours[pos], serials[pos] = eh, sb
-
+                host_pos += self._consume_chunk(batch, device_pos, res)
+            host_lane_total += self._host_lanes(
+                host_pos, lambda pos: entries[pos][0], res
+            )
         self.metrics["host_lane"] += host_lane_total
+        res.host_lane_count = host_lane_total
         incr_counter("aggregator", "batches")
-        return IngestResult(
-            was_unknown=was_unknown,
-            filtered=filtered,
-            exp_hours=exp_hours,
-            serials=serials,
-            issuer_idx=issuer_idx,
-            host_lane_count=host_lane_total,
-        )
+        return res
 
-    def _device_step(self, device_entries):
-        batch = packing.pack_entries(
-            device_entries, batch_size=self.batch_size
+    def ingest_packed(
+        self,
+        data: np.ndarray,
+        length: np.ndarray,
+        issuer_idx: np.ndarray,
+        valid: np.ndarray,
+    ) -> IngestResult:
+        """The zero-copy fast path: pre-packed rows (e.g. from the
+        native batch decoder) go straight to the device, no per-entry
+        Python objects. ``issuer_idx`` are registry indices
+        (:meth:`IssuerRegistry.get_or_assign`); invalid lanes are
+        ignored. Host-lane fallbacks slice their DER from ``data``."""
+        n = int(data.shape[0])
+        res = IngestResult(
+            was_unknown=np.zeros((n,), bool),
+            filtered=np.zeros((n,), bool),
+            exp_hours=np.zeros((n,), np.int32),
+            serials=[None] * n,
+            issuer_idx=np.asarray(issuer_idx, np.int32).copy(),
         )
+        host_lane_total = 0
+        for start in range(0, n, self.batch_size):
+            end = min(start + self.batch_size, n)
+            m = end - start
+            if m == self.batch_size:
+                batch = packing.PackedBatch(
+                    data[start:end], length[start:end],
+                    res.issuer_idx[start:end], valid[start:end],
+                )
+            else:  # pad the tail chunk to the compiled batch shape
+                b = self.batch_size
+                pdata = np.zeros((b, data.shape[1]), np.uint8)
+                pdata[:m] = data[start:end]
+                plen = np.zeros((b,), np.int32)
+                plen[:m] = length[start:end]
+                pidx = np.zeros((b,), np.int32)
+                pidx[:m] = res.issuer_idx[start:end]
+                pval = np.zeros((b,), bool)
+                pval[:m] = valid[start:end]
+                batch = packing.PackedBatch(pdata, plen, pidx, pval)
+            device_pos = [start + j for j in range(m) if valid[start + j]]
+            # lanes in the packed batch correspond 1:1 with positions
+            # only when every lane is valid; map explicitly otherwise.
+            if len(device_pos) != m:
+                lane_of_pos = {start + j: j for j in range(m)}
+            else:
+                lane_of_pos = None
+            host_pos = self._consume_chunk(
+                batch, device_pos, res,
+                lane_of=(None if lane_of_pos is None
+                         else lambda pos: lane_of_pos[pos]),
+            )
+            host_lane_total += self._host_lanes(
+                host_pos,
+                lambda pos: data[pos, : length[pos]].tobytes(),
+                res,
+            )
+        self.metrics["host_lane"] += host_lane_total
+        res.host_lane_count = host_lane_total
+        incr_counter("aggregator", "batches")
+        return res
+
+    def _consume_chunk(self, batch, device_pos, res, lane_of=None):
+        """Run one packed chunk on device and fold the outputs into
+        ``res`` at the global positions ``device_pos``. Returns the
+        positions that must take the exact host lane."""
+        out = self._device_step_packed(batch)
+        hl = np.asarray(out.host_lane)
+        wu = np.asarray(out.was_unknown)
+        nah = np.asarray(out.not_after_hour)
+        slen = np.asarray(out.serial_len)
+        sarr = np.asarray(out.serials)
+        f_any = (
+            np.asarray(out.filtered_ca)
+            | np.asarray(out.filtered_expired)
+            | np.asarray(out.filtered_cn)
+        )
+        self.metrics["filtered_ca"] += int(np.asarray(out.filtered_ca).sum())
+        self.metrics["filtered_expired"] += int(
+            np.asarray(out.filtered_expired).sum()
+        )
+        self.metrics["filtered_cn"] += int(np.asarray(out.filtered_cn).sum())
+        self.issuer_totals += np.asarray(out.issuer_unknown_counts, np.int64)
+
+        host_pos = []
+        for i, pos in enumerate(device_pos):
+            lane = lane_of(pos) if lane_of is not None else i
+            if hl[lane]:
+                host_pos.append(pos)
+                continue
+            res.filtered[pos] = f_any[lane]
+            if not f_any[lane]:
+                res.exp_hours[pos] = nah[lane]
+                res.serials[pos] = sarr[lane, : slen[lane]].tobytes()
+                if wu[lane]:
+                    # Cross-encoding guard (see module docstring).
+                    key = (int(batch.issuer_idx[lane]), int(nah[lane]))
+                    if res.serials[pos] in self.host_serials.get(key, ()):
+                        wu[lane] = False
+                    else:
+                        res.was_unknown[pos] = True
+        self._accumulate_metadata_lanes(
+            batch, out,
+            [(lane_of(pos) if lane_of is not None else i, pos)
+             for i, pos in enumerate(device_pos)],
+            res.was_unknown,
+        )
+        dev_unknown = int(wu.sum())
+        dev_known = len(device_pos) - int(hl.sum()) - dev_unknown
+        self.metrics["inserted"] += dev_unknown
+        self.metrics["known"] += max(dev_known, 0)
+        return host_pos
+
+    def _host_lanes(self, host_pos, der_of, res) -> int:
+        """Exact host path for flagged + oversized lanes."""
+        for pos in host_pos:
+            u, f, eh, sb = self._host_exact(
+                der_of(pos), int(res.issuer_idx[pos])
+            )
+            res.was_unknown[pos], res.filtered[pos] = u, f
+            res.exp_hours[pos], res.serials[pos] = eh, sb
+        return len(host_pos)
+
+    def _device_step_packed(self, batch):
         self.table, out = pipeline.ingest_step(
             self.table,
             batch.data,
@@ -289,13 +362,14 @@ class TpuAggregator:
             self._prefix_lens,
             max_probes=self.max_probes,
         )
-        return out, batch
+        return out
 
-    def _accumulate_metadata(self, batch, out, device_pos, was_unknown_global):
+    def _accumulate_metadata_lanes(self, batch, out, lane_pos, was_unknown_global):
         """CRL/DN accumulation for device-unknown lanes, keyed by raw
-        byte windows so each distinct encoding is parsed once."""
+        byte windows so each distinct encoding is parsed once.
+        ``lane_pos``: (chunk lane, global position) pairs."""
         wu_lanes = [
-            lane for lane, pos in enumerate(device_pos) if was_unknown_global[pos]
+            lane for lane, pos in lane_pos if was_unknown_global[pos]
         ]
         if not wu_lanes:
             return

@@ -61,9 +61,8 @@ class ShardedAggregator(TpuAggregator):
     def _drain_table(self) -> tuple[np.ndarray, np.ndarray]:
         return self.dedup.drain_np()
 
-    def _device_step(self, device_entries):
-        batch = packing.pack_entries(device_entries, batch_size=self.batch_size)
-        out = self.dedup.step(
+    def _device_step_packed(self, batch):
+        return self.dedup.step(
             np.asarray(batch.data),
             np.asarray(batch.length),
             np.asarray(batch.issuer_idx),
@@ -72,7 +71,6 @@ class ShardedAggregator(TpuAggregator):
             cn_prefixes=self._prefix_arr,
             cn_prefix_lens=self._prefix_lens,
         )
-        return out, batch
 
     # -- checkpoint ------------------------------------------------------
     def save_checkpoint(self, path: str) -> None:

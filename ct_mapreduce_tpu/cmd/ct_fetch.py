@@ -118,6 +118,8 @@ def main(argv: list[str] | None = None) -> int:
         limit=config.limit,
         save_period_s=parse_duration(config.save_period),
         checkpoint_hook=checkpoint_hook,
+        # TPU mode streams whole responses to the native batch decoder.
+        raw_batches=model is not None,
     )
     engine.start_store_threads()
 

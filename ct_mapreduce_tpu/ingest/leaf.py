@@ -141,19 +141,39 @@ def decode_entry(
         timestamp_ms=timestamp_ms,
         entry_type=entry_type,
         cert_der=cert_der,
-        issuer_der=chain[0] if chain else None,
+        # A zero-length chain[0] counts as no issuer, like the native
+        # decoder (ctmr_native.cpp CTMR_NO_CHAIN).
+        issuer_der=chain[0] if chain and chain[0] else None,
         chain=chain,
         issuer_key_hash=ikh,
     )
 
 
 def decode_json_entry(index: int, obj: dict) -> DecodedEntry:
-    """Decode one element of a get-entries JSON response."""
-    return decode_entry(
-        index,
-        base64.b64decode(obj["leaf_input"]),
-        base64.b64decode(obj.get("extra_data", "") or ""),
-    )
+    """Decode one element of a get-entries JSON response. Base64 is
+    validated strictly — bad encodings raise :class:`LeafDecodeError`
+    (same taxonomy as structural decode failures), keeping this path,
+    the Python batch fallback, and the native decoder in agreement."""
+    try:
+        li = base64.b64decode(obj["leaf_input"], validate=True)
+        ed = base64.b64decode(obj.get("extra_data", "") or "", validate=True)
+    except (base64.binascii.Error, ValueError) as err:
+        raise LeafDecodeError(f"bad base64: {err}") from None
+    return decode_entry(index, li, ed)
+
+
+def leaf_timestamp_ms(leaf_input_b64: str) -> Optional[int]:
+    """Timestamp from a base64 leaf_input WITHOUT full decode — reads
+    only the first 12 wire bytes (version ‖ type ‖ timestamp). Used by
+    the raw-batch path to stamp checkpoints cheaply; returns None on
+    any structural surprise."""
+    try:
+        head = base64.b64decode(leaf_input_b64[:16])
+    except (ValueError, base64.binascii.Error):
+        return None
+    if len(head) < 10 or head[0] != 0 or head[1] != 0:
+        return None
+    return int.from_bytes(head[2:10], "big")
 
 
 # ---------------------------------------------------------------------------

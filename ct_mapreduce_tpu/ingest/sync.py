@@ -37,7 +37,12 @@ from typing import Optional, Protocol
 from ct_mapreduce_tpu.core import der as hostder
 from ct_mapreduce_tpu.core.types import CertificateLog
 from ct_mapreduce_tpu.ingest.ctclient import BATCH_SIZE, CTLogClient
-from ct_mapreduce_tpu.ingest.leaf import DecodedEntry, LeafDecodeError, decode_json_entry
+from ct_mapreduce_tpu.ingest.leaf import (
+    DecodedEntry,
+    LeafDecodeError,
+    decode_json_entry,
+    leaf_timestamp_ms as decode_leaf_timestamp,
+)
 from ct_mapreduce_tpu.telemetry import metrics
 
 ENTRY_QUEUE_CAPACITY = 16384  # ct-fetch.go:132
@@ -117,10 +122,14 @@ class AggregatorSink:
     store workers feed it.
     """
 
+    PAD_LEN = 2048  # device row width for the raw path (bucket; certs
+    # above it take the exact host lane, like oversized serials)
+
     def __init__(self, aggregator, flush_size: int = 4096):
         self.aggregator = aggregator
         self.flush_size = flush_size
         self._pending: list[tuple[bytes, bytes]] = []
+        self._pending_raw: list[tuple[str, str]] = []
         self._lock = threading.Lock()
         self._dispatch_lock = threading.Lock()  # one device stream
         self.entries_in = 0
@@ -138,11 +147,93 @@ class AggregatorSink:
         if batch:
             self._dispatch(batch)
 
+    def store_raw_batch(self, raw: "RawBatch") -> None:
+        """Accumulate an undecoded get-entries response; decoded and
+        dispatched natively in flush-size chunks."""
+        pairs = list(zip(raw.leaf_inputs, raw.extra_datas))
+        chunk: Optional[list[tuple[str, str]]] = None
+        with self._lock:
+            self._pending_raw.extend(pairs)
+            self.entries_in += len(pairs)
+            if len(self._pending_raw) >= self.flush_size:
+                chunk, self._pending_raw = self._pending_raw, []
+        if chunk:
+            self._dispatch_raw(chunk)
+
+    def _dispatch_raw(self, pairs: list[tuple[str, str]]) -> None:
+        import numpy as np
+
+        from ct_mapreduce_tpu.ingest.leaf import LeafDecodeError, decode_entry
+        from ct_mapreduce_tpu.native import leafpack
+
+        lis = [p[0] for p in pairs]
+        eds = [p[1] for p in pairs]
+        with metrics.measure("ct-fetch", "decodeBatch"):
+            dec = leafpack.decode_raw_batch(lis, eds, self.PAD_LEN)
+
+        n = len(pairs)
+        issuer_idx = np.zeros((n,), np.int32)
+        valid = np.zeros((n,), bool)
+        # Distinct issuer DERs registered once per batch.
+        idx_cache: dict[bytes, int] = {}
+        oversized: list[tuple[bytes, bytes]] = []
+        for i in range(n):
+            st = int(dec.status[i])
+            if st == leafpack.OK:
+                iss = dec.issuers[i]
+                idx = idx_cache.get(iss)
+                if idx is None:
+                    try:
+                        idx = self.aggregator.registry.get_or_assign(iss)
+                    except Exception:
+                        # Malformed issuer DER must cost ONE entry, not
+                        # the whole chunk (per-entry path parity).
+                        idx = -1
+                    idx_cache[iss] = idx
+                if idx < 0:
+                    metrics.incr_counter("ct-fetch", "parseLeafError")
+                    continue
+                issuer_idx[i] = idx
+                valid[i] = True
+            elif st == leafpack.NO_CHAIN:
+                metrics.incr_counter("ct-fetch", "noChainError")
+            elif st == leafpack.TOO_LONG:
+                # Rare oversized cert: exact per-entry lane.
+                try:
+                    import base64
+
+                    e = decode_entry(
+                        i, base64.b64decode(lis[i]), base64.b64decode(eds[i] or "")
+                    )
+                except LeafDecodeError:
+                    metrics.incr_counter("ct-fetch", "parseLeafError")
+                    continue
+                if e.issuer_der is None:
+                    metrics.incr_counter("ct-fetch", "noChainError")
+                else:
+                    oversized.append((e.cert_der, e.issuer_der))
+            else:
+                metrics.incr_counter("ct-fetch", "parseLeafError")
+
+        with self._dispatch_lock, metrics.measure("ct-fetch", "storeCertificate"):
+            if valid.any():
+                self.aggregator.ingest_packed(
+                    dec.data, dec.length, issuer_idx, valid
+                )
+            if oversized:
+                self.aggregator.ingest(oversized)
+        metrics.incr_counter(
+            "ct-fetch", "insertCertificate", value=float(int(valid.sum()))
+        )
+
     def flush(self) -> None:
         with self._lock:
             batch, self._pending = self._pending, []
+            raw, self._pending_raw = self._pending_raw, []
         if batch:
             self._dispatch(batch)
+        if raw:
+            self._dispatch_raw(raw)
 
     def checkpointed_save(self, save_fn) -> None:
         """Flush pending entries, then run ``save_fn`` while holding the
@@ -170,6 +261,21 @@ class AggregatorSink:
 class _QueueItem:
     entry: DecodedEntry
     log_url: str
+
+
+@dataclass
+class RawBatch:
+    """One get-entries response, undecoded — the raw-batch fast path
+    hands whole responses to the sink, which decodes them natively
+    (ct_mapreduce_tpu.native.leafpack) with no per-entry Python."""
+
+    leaf_inputs: list[str]
+    extra_datas: list[str]
+    start_index: int
+    log_url: str
+
+    def __len__(self) -> int:
+        return len(self.leaf_inputs)
 
 
 class LogWorker:
@@ -221,14 +327,17 @@ class LogWorker:
 
     def run(
         self,
-        out: "queue.Queue[Optional[_QueueItem]]",
+        out: "queue.Queue",
         stop: threading.Event,
         save_period_s: float = 900.0,
         progress=None,
+        raw_batches: bool = False,
     ) -> int:
         """Stream ``[start_pos, end_pos]`` into the queue; returns the
         number of entries enqueued. Checkpoints on a ticker and at exit
-        (ct-fetch.go:360-368,472-473)."""
+        (ct-fetch.go:360-368,472-473). With ``raw_batches``, whole
+        get-entries responses are enqueued undecoded for the sink's
+        native batch decoder."""
         enqueued = 0
         next_save = time.monotonic() + save_period_s
         index = self.position
@@ -238,6 +347,37 @@ class LogWorker:
             )
             if not batch:
                 break
+            if raw_batches:
+                item = RawBatch(
+                    leaf_inputs=[r.leaf_input for r in batch],
+                    extra_datas=[r.extra_data for r in batch],
+                    start_index=batch[0].index,
+                    log_url=self.client.log_url,
+                )
+                submitted = False
+                while not stop.is_set():
+                    try:
+                        out.put(item, timeout=0.25)
+                        submitted = True
+                        break
+                    except queue.Full:
+                        continue
+                if not submitted:
+                    break  # cursor stays put: batch never reached a worker
+                enqueued += len(batch)
+                index = batch[-1].index + 1
+                self.position = index
+                ts = decode_leaf_timestamp(batch[-1].leaf_input)
+                if ts is not None:
+                    self.last_entry_time = datetime.fromtimestamp(
+                        ts / 1000.0, tz=timezone.utc
+                    )
+                if progress is not None:
+                    progress(self.client.short_url, self.position, self.end_pos)
+                if time.monotonic() >= next_save:
+                    self.save_state()
+                    next_save = time.monotonic() + save_period_s
+                continue
             for raw in batch:
                 try:
                     with metrics.measure(
@@ -310,6 +450,7 @@ class LogSyncEngine:
         limit: int = 0,
         save_period_s: float = 900.0,
         checkpoint_hook=None,
+        raw_batches: bool = False,
     ):
         self.sink = sink
         self.database = database
@@ -321,9 +462,12 @@ class LogSyncEngine:
         self.offset = offset
         self.limit = limit
         self.save_period_s = save_period_s
-        self.entry_queue: "queue.Queue[Optional[_QueueItem]]" = queue.Queue(
-            maxsize=queue_capacity
-        )
+        self.raw_batches = raw_batches
+        if raw_batches:
+            # Queue items are whole get-entries responses (≤ BATCH_SIZE
+            # entries each); keep the same total-entry bound.
+            queue_capacity = max(2, queue_capacity // BATCH_SIZE)
+        self.entry_queue: "queue.Queue" = queue.Queue(maxsize=queue_capacity)
         self.stop_event = threading.Event()
         self._store_threads: list[threading.Thread] = []
         self._download_threads: list[threading.Thread] = []
@@ -354,14 +498,20 @@ class LogSyncEngine:
                 if item is None:
                     return
                 try:
-                    self.sink.store(item.entry, item.log_url)
+                    if isinstance(item, RawBatch):
+                        self.sink.store_raw_batch(item)
+                    else:
+                        self.sink.store(item.entry, item.log_url)
                 except Exception as err:
                     # A store failure must not kill the worker — the queue
                     # would back up and stop() would deadlock on join().
                     metrics.incr_counter("ct-fetch", "storeError")
-                    self.errors.append(
-                        f"store {item.log_url}@{item.entry.index}: {err}"
+                    where = (
+                        f"{item.log_url}@{item.start_index}"
+                        if isinstance(item, RawBatch)
+                        else f"{item.log_url}@{item.entry.index}"
                     )
+                    self.errors.append(f"store {where}: {err}")
             finally:
                 self.entry_queue.task_done()
 
@@ -395,6 +545,7 @@ class LogSyncEngine:
                     self.stop_event,
                     save_period_s=self.save_period_s,
                     progress=self._note_progress,
+                    raw_batches=self.raw_batches,
                 )
             except Exception as err:  # log-level failures never kill the run
                 metrics.incr_counter("ct-fetch", "syncLogError")

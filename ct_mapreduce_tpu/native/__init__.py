@@ -1,0 +1,97 @@
+"""Native host-side ingest accelerator (C++ via ctypes).
+
+Loads (building on first use, cached beside the source) the compiled
+batch decoder in :file:`ctmr_native.cpp`. Everything degrades to the
+pure-Python lanes when no compiler is available — the native path is a
+throughput optimization, never a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ctmr_native.cpp")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_FAILED = False
+
+
+def _so_path() -> str:
+    # Cache beside the source when writable, else in ~/.cache.
+    if os.access(_HERE, os.W_OK):
+        return os.path.join(_HERE, "libctmr_native.so")
+    cache = os.path.join(
+        os.path.expanduser("~"), ".cache", "ct_mapreduce_tpu"
+    )
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, "libctmr_native.so")
+
+
+def _build(so: str) -> bool:
+    for cxx in ("g++", "c++", "clang++"):
+        try:
+            res = subprocess.run(
+                [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
+                 "-o", so, _SRC],
+                capture_output=True, timeout=240,
+            )
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            continue
+        if res.returncode == 0:
+            return True
+    return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, or None when unavailable (no compiler)."""
+    global _LIB, _LOAD_FAILED
+    if _LIB is not None or _LOAD_FAILED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _LOAD_FAILED:
+            return _LIB
+        so = _so_path()
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(_SRC)):
+            if not _build(so):
+                _LOAD_FAILED = True
+                return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            _LOAD_FAILED = True
+            return None
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.ctmr_decode_entries.restype = ctypes.c_int64
+        lib.ctmr_decode_entries.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_char_p, i64p,
+            ctypes.c_char_p, i64p,
+            ctypes.c_int64,
+            u8p, i32p,
+            i64p, i32p,
+            u8p, ctypes.c_int64,
+            i64p, i32p,
+            i32p,
+            u8p, ctypes.c_int64,
+        ]
+        lib.ctmr_pack_ders.restype = ctypes.c_int64
+        lib.ctmr_pack_ders.argtypes = [
+            ctypes.c_int64,
+            u8p, i64p,
+            ctypes.c_int64,
+            u8p, i32p, u8p,
+        ]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return load() is not None

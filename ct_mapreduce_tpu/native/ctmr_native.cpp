@@ -1,0 +1,243 @@
+// Native host-side ingest accelerator.
+//
+// The reference does its per-entry host work (base64, TLS-struct leaf
+// decode, buffer shuffling) in compiled Go; the Python rebuild keeps
+// parity lanes in Python but runs the BULK host path here: batched
+// base64 decode, RFC 6962 MerkleTreeLeaf/extra_data decoding, and
+// packing certificate bytes into the fixed-width [B, L] device layout
+// (ct_mapreduce_tpu/core/packing.py schema). One call handles a whole
+// get-entries batch with zero Python-object overhead; Python keeps the
+// exact fallback (ct_mapreduce_tpu/ingest/leaf.py) for lanes this
+// decoder flags.
+//
+// ABI: plain C, consumed via ctypes (no pybind11 in the image). All
+// buffers are caller-allocated numpy arrays.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// RFC 4648 base64 (standard alphabet, '=' padding). Returns decoded
+// length, or -1 on bad input. Whitespace is not tolerated — CT JSON
+// carries clean base64.
+struct B64Table {
+  int8_t t[256];
+  B64Table() {
+    for (int i = 0; i < 256; ++i) t[i] = -1;
+    const char* alpha =
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+    for (int i = 0; i < 64; ++i) t[(uint8_t)alpha[i]] = (int8_t)i;
+  }
+};
+
+int64_t b64_decode(const char* in, int64_t in_len, uint8_t* out) {
+  // C++ magic static: thread-safe one-time init (multiple store
+  // workers decode concurrently).
+  static const B64Table table;
+  // Match Python's b64decode(validate=True): total length must be a
+  // multiple of 4 (padding included); any non-alphabet byte is fatal.
+  if (in_len % 4 != 0) return -1;
+  while (in_len > 0 && in[in_len - 1] == '=') --in_len;
+  int64_t out_len = 0;
+  uint32_t acc = 0;
+  int bits = 0;
+  for (int64_t i = 0; i < in_len; ++i) {
+    int8_t v = table.t[(uint8_t)in[i]];
+    if (v < 0) return -1;
+    acc = (acc << 6) | (uint32_t)v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out[out_len++] = (uint8_t)((acc >> bits) & 0xFF);
+    }
+  }
+  return out_len;
+}
+
+struct Reader {
+  const uint8_t* p;
+  int64_t len;
+  int64_t pos = 0;
+  bool ok = true;
+
+  uint64_t uint(int width) {
+    if (pos + width > len) { ok = false; return 0; }
+    uint64_t v = 0;
+    for (int i = 0; i < width; ++i) v = (v << 8) | p[pos + i];
+    pos += width;
+    return v;
+  }
+  // TLS opaque<len_width>: returns (offset, length) into p.
+  bool opaque(int len_width, int64_t* off, int64_t* olen) {
+    uint64_t n = uint(len_width);
+    if (!ok || pos + (int64_t)n > len) { ok = false; return false; }
+    *off = pos;
+    *olen = (int64_t)n;
+    pos += (int64_t)n;
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Status codes per entry (mirrors ingest/leaf.py error taxonomy).
+enum {
+  CTMR_OK = 0,
+  CTMR_BAD_B64 = 1,
+  CTMR_BAD_LEAF = 2,
+  CTMR_UNSUPPORTED = 3,   // version/leaf_type/entry_type unknown
+  CTMR_NO_CHAIN = 4,      // no issuer certificate in extra_data
+  CTMR_TOO_LONG = 5,      // cert exceeds pad_len (host lane)
+};
+
+// Decode one get-entries batch and pack leaf certificates.
+//
+// Inputs: n entries; leaf_input/extra_data base64 blobs concatenated in
+// `li_buf`/`ed_buf` with offsets (n+1 entries, prefix-sum style).
+// Outputs:
+//   data      [n, pad_len] uint8  — packed certificate DER (zero-padded)
+//   length    [n] int32           — true DER length (0 on error lanes)
+//   ts_ms     [n] int64           — leaf timestamps
+//   entry_ty  [n] int32           — 0 x509 / 1 precert
+//   issuer_off/issuer_len [n] int64/int32 — issuer (chain[0]) DER span
+//       inside scratch; issuer bytes are written to `issuer_buf`
+//       sequentially; issuer_cap is its capacity.
+//   status    [n] int32
+// Returns bytes used in issuer_buf, or -1 if issuer_buf overflowed.
+int64_t ctmr_decode_entries(
+    int64_t n,
+    const char* li_buf, const int64_t* li_off,
+    const char* ed_buf, const int64_t* ed_off,
+    int64_t pad_len,
+    uint8_t* data, int32_t* length,
+    int64_t* ts_ms, int32_t* entry_ty,
+    uint8_t* issuer_buf, int64_t issuer_cap,
+    int64_t* issuer_off, int32_t* issuer_len,
+    int32_t* status,
+    uint8_t* scratch, int64_t scratch_cap) {
+  int64_t issuer_used = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    status[i] = CTMR_OK;
+    length[i] = 0;
+    ts_ms[i] = 0;
+    entry_ty[i] = 0;
+    issuer_off[i] = 0;
+    issuer_len[i] = 0;
+    uint8_t* row = data + i * pad_len;
+    std::memset(row, 0, (size_t)pad_len);
+
+    // -- leaf_input ---------------------------------------------------
+    const char* li = li_buf + li_off[i];
+    int64_t li_n = li_off[i + 1] - li_off[i];
+    if ((li_n * 3) / 4 + 4 > scratch_cap) { status[i] = CTMR_BAD_B64; continue; }
+    int64_t li_dec = b64_decode(li, li_n, scratch);
+    if (li_dec < 0) { status[i] = CTMR_BAD_B64; continue; }
+
+    Reader r{scratch, li_dec};
+    uint64_t version = r.uint(1);
+    uint64_t leaf_type = r.uint(1);
+    if (!r.ok || version != 0 || leaf_type != 0) {
+      status[i] = r.ok ? CTMR_UNSUPPORTED : CTMR_BAD_LEAF;
+      continue;
+    }
+    uint64_t ts = r.uint(8);
+    uint64_t ety = r.uint(2);
+    if (!r.ok) { status[i] = CTMR_BAD_LEAF; continue; }
+    ts_ms[i] = (int64_t)ts;
+    entry_ty[i] = (int32_t)ety;
+
+    int64_t cert_off = 0, cert_len = 0;
+    if (ety == 0) {  // x509_entry: leaf cert in leaf_input
+      if (!r.opaque(3, &cert_off, &cert_len)) { status[i] = CTMR_BAD_LEAF; continue; }
+    } else if (ety == 1) {  // precert: issuer_key_hash + TBS (unused)
+      r.pos += 32;
+      int64_t toff, tlen;
+      if (r.pos > r.len || !r.opaque(3, &toff, &tlen)) {
+        status[i] = CTMR_BAD_LEAF; continue;
+      }
+    } else {
+      status[i] = CTMR_UNSUPPORTED;
+      continue;
+    }
+    // extensions<2> — ignored (leaf.py ignores them too)
+
+    const uint8_t* cert_src = scratch + cert_off;
+
+    // -- extra_data ---------------------------------------------------
+    const char* ed = ed_buf + ed_off[i];
+    int64_t ed_n = ed_off[i + 1] - ed_off[i];
+    uint8_t* ed_scratch = scratch + (li_dec + 7) / 8 * 8;
+    int64_t ed_cap = scratch_cap - (li_dec + 7) / 8 * 8;
+    int64_t ed_dec = 0;
+    if (ed_n > 0) {
+      if ((ed_n * 3) / 4 + 4 > ed_cap) { status[i] = CTMR_BAD_B64; continue; }
+      ed_dec = b64_decode(ed, ed_n, ed_scratch);
+      if (ed_dec < 0) { status[i] = CTMR_BAD_B64; continue; }
+    }
+
+    Reader er{ed_scratch, ed_dec};
+    if (ety == 1) {
+      // PrecertChainEntry: pre_certificate<3> is what gets stored.
+      int64_t poff, plen;
+      if (!er.opaque(3, &poff, &plen)) { status[i] = CTMR_BAD_LEAF; continue; }
+      cert_src = ed_scratch + poff;
+      cert_len = plen;
+    }
+    // chain (both types): outer <3> frame of <3>-prefixed certs.
+    int64_t chain_issuer_off = -1, chain_issuer_len = 0;
+    if (er.pos < er.len) {
+      int64_t foff, flen;
+      if (er.opaque(3, &foff, &flen)) {
+        Reader cr{ed_scratch + foff, flen};
+        int64_t c0off, c0len;
+        if (cr.pos < cr.len && cr.opaque(3, &c0off, &c0len)) {
+          chain_issuer_off = foff + c0off;
+          chain_issuer_len = c0len;
+        }
+      }
+    }
+
+    if (cert_len > pad_len) { status[i] = CTMR_TOO_LONG; continue; }
+    std::memcpy(row, cert_src, (size_t)cert_len);
+    length[i] = (int32_t)cert_len;
+
+    if (chain_issuer_off < 0 || chain_issuer_len == 0) {
+      status[i] = CTMR_NO_CHAIN;  // cert still packed; caller decides
+      continue;
+    }
+    if (issuer_used + chain_issuer_len > issuer_cap) return -1;
+    std::memcpy(issuer_buf + issuer_used, ed_scratch + chain_issuer_off,
+                (size_t)chain_issuer_len);
+    issuer_off[i] = issuer_used;
+    issuer_len[i] = (int32_t)chain_issuer_len;
+    issuer_used += chain_issuer_len;
+  }
+  return issuer_used;
+}
+
+// Pack pre-decoded DER blobs (concatenated in `blob` with prefix-sum
+// offsets) into the [n, pad_len] device layout. Returns count packed;
+// lanes whose cert exceeds pad_len get length 0 and ok[i] = 0.
+int64_t ctmr_pack_ders(
+    int64_t n,
+    const uint8_t* blob, const int64_t* off,
+    int64_t pad_len,
+    uint8_t* data, int32_t* length, uint8_t* okflags) {
+  int64_t packed = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint8_t* row = data + i * pad_len;
+    std::memset(row, 0, (size_t)pad_len);
+    int64_t len = off[i + 1] - off[i];
+    if (len > pad_len) { length[i] = 0; okflags[i] = 0; continue; }
+    std::memcpy(row, blob + off[i], (size_t)len);
+    length[i] = (int32_t)len;
+    okflags[i] = 1;
+    ++packed;
+  }
+  return packed;
+}
+
+}  // extern "C"

@@ -245,6 +245,86 @@ def test_sync_end_to_end_aggregator_sink():
     assert sink.entries_in == 4
 
 
+def test_sync_raw_batch_mode_matches_per_entry():
+    """The native raw-batch fast path must produce the same aggregate
+    state as the per-entry path, including garbage tolerance and
+    checkpoint semantics."""
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+
+    def build_log():
+        log = FakeLog()
+        issuer_der = certgen.make_cert(serial=1, issuer_cn="Raw CA",
+                                       is_ca=True, not_after=FUTURE)
+        for s in [700, 701, 700, 702, 703, 701]:
+            leaf = certgen.make_cert(
+                serial=s, issuer_cn="Raw CA", subject_cn="r.example.com",
+                is_ca=False, not_after=FUTURE,
+            )
+            log.add_cert(leaf, issuer_der, timestamp_ms=1700000000000 + s)
+        log.add_garbage()
+        ca = certgen.make_cert(serial=900, issuer_cn="Raw CA", is_ca=True,
+                               not_after=FUTURE)
+        log.add_cert(ca, issuer_der)
+        return log
+
+    results = []
+    for raw in (False, True):
+        log = build_log()
+        agg = TpuAggregator(capacity=1 << 12, batch_size=64,
+                            now=datetime.datetime(2025, 1, 1, tzinfo=UTC))
+        db = _db()
+        sink = AggregatorSink(agg, flush_size=4)
+        engine = LogSyncEngine(sink, db, num_threads=2, raw_batches=raw)
+        engine.start_store_threads()
+        engine.sync_log(log.url, transport=log.transport)
+        engine.wait_for_downloads(timeout=60)
+        engine.stop()
+        assert not engine.errors, engine.errors
+        snap = agg.drain()
+        st = db.get_log_state("ct.example.com/fake")
+        results.append((snap.counts, snap.total, st.max_entry,
+                        st.last_entry_time))
+    assert results[0][:3] == results[1][:3]
+    assert results[1][1] == 4  # 700,701,702,703
+    assert results[1][2] == 8  # cursor past garbage + CA
+    assert results[1][3] is not None  # timestamp recovered from prefix
+
+
+def test_raw_batch_oversized_cert_host_lane():
+    """A cert above the raw-path pad bucket takes the exact host lane
+    and still lands in the aggregate."""
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.ingest.sync import RawBatch
+    import base64
+
+    from ct_mapreduce_tpu.ingest import leaf as leaflib
+
+    issuer_der = certgen.make_cert(serial=1, issuer_cn="Big CA", is_ca=True,
+                                   not_after=FUTURE)
+    big = certgen.make_cert(
+        serial=41, issuer_cn="Big CA", subject_cn="b.example.com",
+        is_ca=False, not_after=FUTURE,
+        crl_dps=tuple(f"http://crl{i}.big.example/{'p' * 60}.crl"
+                      for i in range(12)),
+    )
+    small = certgen.make_cert(serial=42, issuer_cn="Big CA",
+                              is_ca=False, not_after=FUTURE)
+    assert len(small) <= 768 < len(big), (len(small), len(big))
+    agg = TpuAggregator(capacity=1 << 12, batch_size=64,
+                        now=datetime.datetime(2025, 1, 1, tzinfo=UTC))
+    sink = AggregatorSink(agg, flush_size=64)
+    sink.PAD_LEN = 768  # force the big cert over the bucket
+    lis, eds = [], []
+    for der in (big, small):
+        lis.append(base64.b64encode(
+            leaflib.encode_leaf_input(der, 1)).decode())
+        eds.append(base64.b64encode(
+            leaflib.encode_extra_data([issuer_der])).decode())
+    sink.store_raw_batch(RawBatch(lis, eds, 0, "log"))
+    sink.flush()
+    assert agg.drain().total == 2
+
+
 # -- health -----------------------------------------------------------------
 
 

@@ -1,0 +1,127 @@
+"""Native batch decoder: build, parity vs the Python leaf codec, and
+throughput sanity. The native .so is a throughput optimization only —
+`_decode_python` must produce byte-identical results, and the tests
+run BOTH paths against the same wire data."""
+
+import base64
+import datetime
+
+import numpy as np
+import pytest
+
+from ct_mapreduce_tpu.ingest import leaf as leaflib
+from ct_mapreduce_tpu.native import available, leafpack
+
+from tests import certgen
+
+UTC = datetime.timezone.utc
+FUTURE = datetime.datetime(2031, 6, 15, tzinfo=UTC)
+
+
+def _wire_batch():
+    issuer = certgen.make_cert(serial=1, issuer_cn="Native CA", is_ca=True,
+                               not_after=FUTURE)
+    lis, eds, expect = [], [], []
+    for s in (10, 11, 12):
+        leaf = certgen.make_cert(serial=s, issuer_cn="Native CA",
+                                 is_ca=False, not_after=FUTURE)
+        li = leaflib.encode_leaf_input(leaf, timestamp_ms=1700000000000 + s)
+        ed = leaflib.encode_extra_data([issuer])
+        lis.append(base64.b64encode(li).decode())
+        eds.append(base64.b64encode(ed).decode())
+        expect.append(leaf)
+    # precert entry
+    pre = certgen.make_cert(serial=99, issuer_cn="Native CA", is_ca=False,
+                            not_after=FUTURE)
+    li = leaflib.encode_leaf_input(b"\x00" * 12, timestamp_ms=5,
+                                   entry_type=leaflib.PRECERT_ENTRY)
+    ed = leaflib.encode_extra_data([issuer], entry_type=leaflib.PRECERT_ENTRY,
+                                   pre_certificate=pre)
+    lis.append(base64.b64encode(li).decode())
+    eds.append(base64.b64encode(ed).decode())
+    expect.append(pre)
+    # garbage base64 + garbage leaf + no chain
+    lis.append("!!!notb64!!!")
+    eds.append("")
+    expect.append(None)
+    lis.append(base64.b64encode(b"\xff\xff\x00").decode())
+    eds.append("")
+    expect.append(None)
+    leaf_nochain = certgen.make_cert(serial=13, issuer_cn="Native CA",
+                                     is_ca=False, not_after=FUTURE)
+    lis.append(base64.b64encode(
+        leaflib.encode_leaf_input(leaf_nochain, timestamp_ms=7)).decode())
+    eds.append("")
+    expect.append(leaf_nochain)
+    return lis, eds, expect, issuer
+
+
+def _check(batch, expect, issuer):
+    assert batch.status[0] == leafpack.OK
+    for i, exp in enumerate(expect):
+        if exp is None:
+            assert batch.status[i] in (leafpack.BAD_B64, leafpack.BAD_LEAF,
+                                       leafpack.UNSUPPORTED)
+            assert batch.length[i] == 0
+        else:
+            got = batch.data[i, : batch.length[i]].tobytes()
+            assert got == exp, f"lane {i} cert mismatch"
+    # first three lanes: x509 with issuer
+    for i in range(3):
+        assert batch.entry_type[i] == leaflib.X509_ENTRY
+        assert batch.issuers[i] == issuer
+        assert batch.timestamp_ms[i] == 1700000000000 + (10 + i)
+    # precert lane
+    assert batch.entry_type[3] == leaflib.PRECERT_ENTRY
+    assert batch.issuers[3] == issuer
+    # no-chain lane: cert packed, NO_CHAIN status
+    assert batch.status[6] == leafpack.NO_CHAIN
+    assert batch.length[6] > 0
+    assert batch.issuers[6] is None
+
+
+def test_python_fallback_decode():
+    lis, eds, expect, issuer = _wire_batch()
+    batch = leafpack._decode_python(lis, eds, pad_len=2048)
+    _check(batch, expect, issuer)
+
+
+@pytest.mark.skipif(not available(), reason="no C++ compiler")
+def test_native_decode_matches_python():
+    lis, eds, expect, issuer = _wire_batch()
+    nat = leafpack.decode_raw_batch(lis, eds, pad_len=2048)
+    _check(nat, expect, issuer)
+    py = leafpack._decode_python(lis, eds, pad_len=2048)
+    np.testing.assert_array_equal(nat.data, py.data)
+    np.testing.assert_array_equal(nat.length, py.length)
+    np.testing.assert_array_equal(nat.timestamp_ms, py.timestamp_ms)
+    np.testing.assert_array_equal(nat.entry_type, py.entry_type)
+    np.testing.assert_array_equal(nat.status, py.status)
+    assert nat.issuers == py.issuers
+
+
+@pytest.mark.skipif(not available(), reason="no C++ compiler")
+def test_native_too_long_flagged():
+    lis, eds, expect, issuer = _wire_batch()
+    nat = leafpack.decode_raw_batch(lis[:1], eds[:1], pad_len=64)
+    assert nat.status[0] == leafpack.TOO_LONG
+    assert nat.length[0] == 0
+
+
+@pytest.mark.skipif(not available(), reason="no C++ compiler")
+def test_native_throughput_sanity():
+    """The native path must beat per-entry Python decode comfortably."""
+    import time
+
+    lis, eds, _, _ = _wire_batch()
+    lis, eds = lis[:3] * 700, eds[:3] * 700  # 2100 entries
+
+    t0 = time.perf_counter()
+    nat = leafpack.decode_raw_batch(lis, eds, pad_len=2048)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    py = leafpack._decode_python(lis, eds, pad_len=2048)
+    t_py = time.perf_counter() - t0
+    np.testing.assert_array_equal(nat.data, py.data)
+    assert t_native < t_py, (t_native, t_py)
+    print(f"native {2100/t_native:,.0f}/s vs python {2100/t_py:,.0f}/s")
