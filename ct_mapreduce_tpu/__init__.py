@@ -9,15 +9,19 @@ batches, sharded over a `jax.sharding.Mesh` for pod-scale reduce.
 
 Layout:
   core/        identity & value types, DER parsing, batch schema
-  ops/         device ops (SHA-256, DER field extraction, hash-set, histograms)
-  agg/         on-device aggregate (reduce) state + drain
-  models/      the end-to-end jitted pipeline ("flagship model")
-  parallel/    mesh construction, shardings, multi-host init
+  ops/         device ops (SHA-256 incl. Pallas kernel, DER field
+               extraction, hash-set dedup, fused ingest step)
+  agg/         aggregate (reduce) state: single-chip + mesh-sharded
+               (all_to_all key routing), exact host lane, drain
+  models/      config → mesh → aggregator composition root
+  parallel/    mesh construction, multi-host init, TPU-native coordinator
+  native/      C++ batch leaf decoder (ctypes; pure-Python fallback)
   storage/     pluggable backends + CertDatabase facade (reference parity)
-  ingest/      CT log HTTP client, entry decode, batching, checkpointing
-  coordinator/ multi-process leader election / start barrier
+  ingest/      CT log HTTP client, RFC 6962 leaf codec, sync engine,
+               raw-batch fast path, health endpoint
+  coordinator/ Redis-parity leader election / start barrier
   config/      layered ini < env < flags configuration
-  telemetry/   metrics registry, dumper, StatsD sink, health endpoint
+  telemetry/   metrics registry, dumper, StatsD sink
   cmd/         CLI entry points (ct-fetch, storage-statistics, ct-getcert)
 """
 

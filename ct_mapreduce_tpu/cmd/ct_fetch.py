@@ -69,7 +69,7 @@ class ProgressPrinter:
         print(file=sys.stderr)
 
 
-def build_sink(config: CTConfig, database):
+def build_sink(config: CTConfig, database, backend=None):
     """Pick the store path: per-entry host store (reference parity) or
     the batched device pipeline (single-chip or mesh-sharded per
     meshShape — see models.build_aggregator)."""
@@ -77,8 +77,12 @@ def build_sink(config: CTConfig, database):
         from ct_mapreduce_tpu.models import IngestModel
 
         model = IngestModel.from_config(config)
+        # certPath keeps the reference's durable PEM tree even in TPU
+        # mode; without it the backend is a no-op and is skipped.
+        pem_backend = backend if config.cert_path else None
         return AggregatorSink(model.aggregator,
-                              flush_size=config.batch_size), model
+                              flush_size=config.batch_size,
+                              backend=pem_backend), model
     sink = DatabaseSink(
         database,
         cn_filters=tuple(config.issuer_cn_filters()),
@@ -95,7 +99,7 @@ def main(argv: list[str] | None = None) -> int:
         print("\nerror: logList is required", file=sys.stderr)
         return 2
 
-    database, _cache, _backend = get_configured_storage(config)
+    database, _cache, _backend = get_configured_storage(config)  # noqa: F841
     dumper = prepare_telemetry("ct-fetch", config)
     if config.issuer_cn_filter:
         # The reference logs a stale "unsupported" warning here
@@ -104,7 +108,7 @@ def main(argv: list[str] | None = None) -> int:
         print(f"IssuerCNFilter enabled: {config.issuer_cn_filters()}",
               file=sys.stderr)
 
-    sink, model = build_sink(config, database)
+    sink, model = build_sink(config, database, _backend)
     checkpoint_hook = None
     if model is not None and config.agg_state_path:
         # Snapshot device aggregates before every durable cursor write —

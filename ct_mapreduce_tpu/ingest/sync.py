@@ -30,6 +30,8 @@ import queue
 import random
 import threading
 import time
+
+import numpy as np
 from dataclasses import dataclass, field
 from datetime import datetime, timezone
 from typing import Optional, Protocol
@@ -125,9 +127,14 @@ class AggregatorSink:
     PAD_LEN = 2048  # device row width for the raw path (bucket; certs
     # above it take the exact host lane, like oversized serials)
 
-    def __init__(self, aggregator, flush_size: int = 4096):
+    def __init__(self, aggregator, flush_size: int = 4096, backend=None):
         self.aggregator = aggregator
         self.flush_size = flush_size
+        # Optional durable backend (certPath): first-seen certs get the
+        # same <exp>/<issuer>/<serial> PEM tree + dirty markers the
+        # reference writes (filesystemdatabase.go:189-208).
+        self.backend = backend
+        self._allocated: set[tuple[str, str]] = set()
         self._pending: list[tuple[bytes, bytes]] = []
         self._pending_raw: list[tuple[str, str]] = []
         self._lock = threading.Lock()
@@ -161,8 +168,6 @@ class AggregatorSink:
             self._dispatch_raw(chunk)
 
     def _dispatch_raw(self, pairs: list[tuple[str, str]]) -> None:
-        import numpy as np
-
         from ct_mapreduce_tpu.ingest.leaf import LeafDecodeError, decode_entry
         from ct_mapreduce_tpu.native import leafpack
 
@@ -217,13 +222,18 @@ class AggregatorSink:
 
         with self._dispatch_lock, metrics.measure("ct-fetch", "storeCertificate"):
             if valid.any():
-                self.aggregator.ingest_packed(
+                res = self.aggregator.ingest_packed(
                     dec.data, dec.length, issuer_idx, valid
                 )
+                self._store_pems(
+                    res, lambda pos: dec.data[pos, : dec.length[pos]].tobytes()
+                )
             if oversized:
-                self.aggregator.ingest(oversized)
+                res_over = self.aggregator.ingest(oversized)
+                self._store_pems(res_over, lambda pos: oversized[pos][0])
         metrics.incr_counter(
-            "ct-fetch", "insertCertificate", value=float(int(valid.sum()))
+            "ct-fetch", "insertCertificate",
+            value=float(int(valid.sum()) + len(oversized)),
         )
 
     def flush(self) -> None:
@@ -251,10 +261,44 @@ class AggregatorSink:
         # ingest calls would race on a deleted buffer.
         with self._dispatch_lock, metrics.measure("ct-fetch", "storeCertificate"):
             result = self.aggregator.ingest(batch)
+            self._store_pems(result, lambda pos: batch[pos][0])
         metrics.incr_counter(
             "ct-fetch", "insertCertificate", value=float(len(batch))
         )
-        del result
+
+    def _store_pems(self, result, der_of) -> None:
+        """Durable PEM tree + dirty markers (parity with
+        filesystemdatabase.go:189-208). No-op without a backend.
+
+        PEMs are written for first-seen certs only, but every
+        non-filtered entry re-marks its expiry day dirty — the
+        reference marks per Store call, known duplicates included
+        (filesystemdatabase.go:141-144,204-208); here that collapses
+        to once per day per dispatch."""
+        if self.backend is None:
+            return
+        from ct_mapreduce_tpu.core.der import der_to_pem
+        from ct_mapreduce_tpu.core.types import ExpDate, Serial
+
+        reg = self.aggregator.registry
+        dirty_days: set[str] = set()
+        for pos, sb in enumerate(result.serials):
+            if sb is None or result.filtered[pos]:
+                continue
+            exp = ExpDate.from_unix_hour(int(result.exp_hours[pos]))
+            dirty_days.add(exp.date.strftime("%Y-%m-%d"))
+            if not result.was_unknown[pos]:
+                continue
+            issuer = reg.issuer_at(int(result.issuer_idx[pos]))
+            pair = (exp.id(), issuer.id())
+            if pair not in self._allocated:
+                self.backend.allocate_exp_date_and_issuer(exp, issuer)
+                self._allocated.add(pair)
+            self.backend.store_certificate_pem(
+                Serial(sb), exp, issuer, der_to_pem(der_of(pos))
+            )
+        for day in dirty_days:
+            self.backend.mark_dirty(day)
 
 
 @dataclass
@@ -367,11 +411,16 @@ class LogWorker:
                 enqueued += len(batch)
                 index = batch[-1].index + 1
                 self.position = index
-                ts = decode_leaf_timestamp(batch[-1].leaf_input)
-                if ts is not None:
-                    self.last_entry_time = datetime.fromtimestamp(
-                        ts / 1000.0, tz=timezone.utc
-                    )
+                # Last DECODABLE timestamp — a garbage final entry must
+                # not lose the good entries' timestamps (per-entry-path
+                # parity: it updates per decoded entry).
+                for raw in reversed(batch):
+                    ts = decode_leaf_timestamp(raw.leaf_input)
+                    if ts is not None:
+                        self.last_entry_time = datetime.fromtimestamp(
+                            ts / 1000.0, tz=timezone.utc
+                        )
+                        break
                 if progress is not None:
                     progress(self.client.short_url, self.position, self.end_pos)
                 if time.monotonic() >= next_save:

@@ -33,17 +33,23 @@ def _so_path() -> str:
 
 
 def _build(so: str) -> bool:
+    # Compile to a temp path and rename atomically — a concurrent
+    # process must never dlopen a half-written .so.
+    tmp = f"{so}.build.{os.getpid()}"
     for cxx in ("g++", "c++", "clang++"):
         try:
             res = subprocess.run(
                 [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
-                 "-o", so, _SRC],
+                 "-o", tmp, _SRC],
                 capture_output=True, timeout=240,
             )
         except (FileNotFoundError, subprocess.TimeoutExpired):
             continue
         if res.returncode == 0:
+            os.replace(tmp, so)
             return True
+    if os.path.exists(tmp):
+        os.unlink(tmp)
     return False
 
 

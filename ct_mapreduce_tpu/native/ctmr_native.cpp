@@ -36,9 +36,12 @@ int64_t b64_decode(const char* in, int64_t in_len, uint8_t* out) {
   // workers decode concurrently).
   static const B64Table table;
   // Match Python's b64decode(validate=True): total length must be a
-  // multiple of 4 (padding included); any non-alphabet byte is fatal.
+  // multiple of 4 (padding included), at most 2 trailing '=' pads, and
+  // any non-alphabet byte is fatal.
   if (in_len % 4 != 0) return -1;
-  while (in_len > 0 && in[in_len - 1] == '=') --in_len;
+  int pads = 0;
+  while (in_len > 0 && in[in_len - 1] == '=') { --in_len; ++pads; }
+  if (pads > 2) return -1;
   int64_t out_len = 0;
   uint32_t acc = 0;
   int bits = 0;
@@ -162,7 +165,13 @@ int64_t ctmr_decode_entries(
       status[i] = CTMR_UNSUPPORTED;
       continue;
     }
-    // extensions<2> — ignored (leaf.py ignores them too)
+    // CtExtensions<2>: content ignored, but the frame must be intact —
+    // leaf.py's r.opaque(2) raises on truncation, so parity demands the
+    // same validation here.
+    {
+      int64_t xoff, xlen;
+      if (!r.opaque(2, &xoff, &xlen)) { status[i] = CTMR_BAD_LEAF; continue; }
+    }
 
     const uint8_t* cert_src = scratch + cert_off;
 
@@ -186,18 +195,27 @@ int64_t ctmr_decode_entries(
       cert_src = ed_scratch + poff;
       cert_len = plen;
     }
-    // chain (both types): outer <3> frame of <3>-prefixed certs.
+    // chain (both types): outer <3> frame of <3>-prefixed certs. The
+    // whole frame must parse — the Python codec's _read_chain raises on
+    // ANY truncated element (not just the first), so a malformed frame
+    // is BAD_LEAF, never a silent "no chain".
     int64_t chain_issuer_off = -1, chain_issuer_len = 0;
     if (er.pos < er.len) {
       int64_t foff, flen;
-      if (er.opaque(3, &foff, &flen)) {
-        Reader cr{ed_scratch + foff, flen};
-        int64_t c0off, c0len;
-        if (cr.pos < cr.len && cr.opaque(3, &c0off, &c0len)) {
-          chain_issuer_off = foff + c0off;
-          chain_issuer_len = c0len;
+      if (!er.opaque(3, &foff, &flen)) { status[i] = CTMR_BAD_LEAF; continue; }
+      Reader cr{ed_scratch + foff, flen};
+      bool chain_ok = true;
+      bool first = true;
+      while (cr.pos < cr.len) {
+        int64_t coff, clen;
+        if (!cr.opaque(3, &coff, &clen)) { chain_ok = false; break; }
+        if (first) {
+          chain_issuer_off = foff + coff;
+          chain_issuer_len = clen;
+          first = false;
         }
       }
+      if (!chain_ok) { status[i] = CTMR_BAD_LEAF; continue; }
     }
 
     if (cert_len > pad_len) { status[i] = CTMR_TOO_LONG; continue; }

@@ -1,0 +1,111 @@
+"""Pallas TPU kernel: batched single-block SHA-256 fingerprinting.
+
+The XLA path (:mod:`ct_mapreduce_tpu.ops.sha256`) compiles the 64
+compression rounds as a ``lax.scan`` with a rolling schedule — correct
+and fast, but every round round-trips its [8, B] state through the
+fusion boundary HBM traffic XLA chooses. This kernel keeps the entire
+state and message schedule resident in VMEM for a tile of lanes and
+runs all 64 rounds register-resident on the VPU: one HBM read of the
+message block, one HBM write of the digest, nothing in between.
+
+Layout: lanes ride the last (128-wide) axis. The [B, 16] message block
+arrives transposed as [16, B]; per grid step the kernel sees a
+[16, TILE] slice, state is an [8, TILE] VMEM scratch, and the rolling
+16-entry schedule mutates the input tile in place.
+
+Selection: :func:`ct_mapreduce_tpu.ops.sha256.sha256_fingerprint64`
+dispatches here when ``CTMR_PALLAS=1`` and the backend is a TPU;
+``interpret=True`` covers CPU tests (tests/test_pallas.py asserts
+bit-equality with the XLA path and hashlib).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ct_mapreduce_tpu.ops.sha256 import _H0, _K
+
+LANE_TILE = 512  # lanes per grid step: 4 VPU lane-groups wide
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _kernel(k_ref, h0_ref, block_ref, out_ref):
+    """k_ref: uint32[64, 1] round constants; h0_ref: uint32[8, 1];
+    block_ref: uint32[16, TILE]; out_ref: uint32[8, TILE].
+
+    (Constants arrive as inputs — Pallas kernels cannot capture array
+    constants from the enclosing trace.)"""
+
+    def round_body(t, carry):
+        state, w = carry
+        a, b, c, d, e, f, g, h = (state[i] for i in range(8))
+        i0 = t % 16
+        wt = jax.lax.dynamic_index_in_dim(w, i0, 0, keepdims=False)
+        kt = jax.lax.dynamic_index_in_dim(
+            k_ref[:], t, 0, keepdims=False
+        )[0]
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kt + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        state = jnp.stack([t1 + t2, a, b, c, d + t1, e, f, g])
+        # Rolling schedule: W[t+16] replaces W[t] in place.
+        w1 = jax.lax.dynamic_index_in_dim(w, (t + 1) % 16, 0, keepdims=False)
+        w9 = jax.lax.dynamic_index_in_dim(w, (t + 9) % 16, 0, keepdims=False)
+        w14 = jax.lax.dynamic_index_in_dim(w, (t + 14) % 16, 0, keepdims=False)
+        sg0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> np.uint32(3))
+        sg1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> np.uint32(10))
+        w = jax.lax.dynamic_update_index_in_dim(w, wt + sg0 + w9 + sg1, i0, 0)
+        return state, w
+
+    w = block_ref[:]  # [16, TILE] — VMEM-resident for all 64 rounds
+    tile = w.shape[1]
+    init = jnp.broadcast_to(h0_ref[:], (8, tile))
+    state, _ = jax.lax.fori_loop(0, 64, round_body, (init, w))
+    out_ref[:] = init + state
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def sha256_single_block_pallas(
+    block: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """uint32[B, 16] pre-padded block → uint32[B, 8] digest."""
+    b = block.shape[0]
+    tile = min(LANE_TILE, b)
+    if b % tile:
+        raise ValueError(f"batch {b} must divide by the lane tile {tile}")
+    blk_t = block.astype(jnp.uint32).T  # [16, B]
+    out = pl.pallas_call(
+        _kernel,
+        grid=(b // tile,),
+        in_specs=[
+            pl.BlockSpec((64, 1), lambda i: (0, 0)),  # K, replicated
+            pl.BlockSpec((8, 1), lambda i: (0, 0)),  # H0, replicated
+            pl.BlockSpec((16, tile), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((8, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((8, b), jnp.uint32),
+        interpret=interpret,
+    )(
+        jnp.asarray(_K).reshape(64, 1),
+        jnp.asarray(_H0).reshape(8, 1),
+        blk_t,
+    )
+    return out.T
+
+
+def sha256_fingerprint64_pallas(
+    block: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Low 128 bits of the digest: uint32[B, 4] (dedup-key path)."""
+    return sha256_single_block_pallas(block, interpret=interpret)[..., 4:]

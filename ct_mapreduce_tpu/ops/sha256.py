@@ -155,14 +155,39 @@ def sha256_single_block(block: jax.Array) -> jax.Array:
     return _compress(state, block)
 
 
-@jax.jit
+def _pallas_enabled(batch: int) -> bool:
+    """Opt-in Pallas kernel: CTMR_PALLAS=1, a real TPU backend, and a
+    batch the lane tiling divides (else the XLA path serves)."""
+    import os
+
+    if os.environ.get("CTMR_PALLAS", "") != "1":
+        return False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except RuntimeError:
+        return False
+    from ct_mapreduce_tpu.ops import pallas_sha256
+
+    tile = min(pallas_sha256.LANE_TILE, batch)
+    return batch % tile == 0
+
+
 def sha256_fingerprint64(block: jax.Array) -> jax.Array:
     """Low 128 bits (words 4..7) of the single-block digest: uint32[B, 4].
 
     Truncation keeps the dedup key compact; collision probability over a
     full CT log (~2^33 entries) is ≪ 2^-60, far below the
     issuer-count-parity gate (SURVEY.md §7 hard part #2).
+
+    Dispatches to the VMEM-resident Pallas kernel
+    (:mod:`ct_mapreduce_tpu.ops.pallas_sha256`) when ``CTMR_PALLAS=1``
+    on TPU; the XLA scan otherwise.
     """
+    if _pallas_enabled(int(block.shape[0])):
+        from ct_mapreduce_tpu.ops import pallas_sha256
+
+        return pallas_sha256.sha256_fingerprint64_pallas(block)
     return sha256_single_block(block)[..., 4:]
 
 

@@ -88,6 +88,32 @@ def test_ct_fetch_database_backend(tmp_path, monkeypatch, capsys):
     assert (certs / "state").exists()
 
 
+def test_ct_fetch_tpu_backend_with_certpath_writes_pems(tmp_path, monkeypatch):
+    """backend=tpu + certPath keeps the reference's durable PEM tree
+    (filesystemdatabase.go:189-208): one PEM per first-seen cert in
+    <exp>/<issuer>/<serial>, plus dirty markers."""
+    log = _fake_log(n=5, dupes=1)
+    _patch_transport(monkeypatch, log)
+    certs = tmp_path / "certs"
+    ini = tmp_path / "ct.ini"
+    ini.write_text(
+        f"logList = {log.url}\n"
+        "backend = tpu\n"
+        "batchSize = 64\n"
+        "tableBits = 12\n"
+        f"certPath = {certs}\n"
+        f"aggStatePath = {tmp_path / 'agg.npz'}\n"
+        "healthAddr = \n"
+    )
+    rc = ct_fetch.main(["-config", str(ini), "-nobars"])
+    assert rc == 0
+    pems = [p for p in certs.rglob("*") if p.is_file()
+            and "state" not in p.parts and not p.name.startswith(".")]
+    assert len(pems) == 4  # 5 entries, 1 dupe
+    assert pems[0].read_bytes().startswith(b"-----BEGIN CERTIFICATE-----")
+    assert list(certs.rglob(".dirty")) or list(certs.rglob("*dirty*"))
+
+
 def test_ct_fetch_requires_loglist(capsys):
     rc = ct_fetch.main(["-nobars"])
     assert rc == 2

@@ -101,6 +101,52 @@ def test_native_decode_matches_python():
 
 
 @pytest.mark.skipif(not available(), reason="no C++ compiler")
+def test_native_python_agree_on_malformed_wire():
+    """The tricky disagreement cases: over-padded base64, truncated
+    extensions frame, truncated chain frame, truncated SECOND chain
+    cert — native and Python must return identical statuses."""
+    issuer = certgen.make_cert(serial=1, issuer_cn="Mal CA", is_ca=True,
+                               not_after=FUTURE)
+    leaf = certgen.make_cert(serial=5, issuer_cn="Mal CA", is_ca=False,
+                             not_after=FUTURE)
+    ok_ed = base64.b64encode(leaflib.encode_extra_data([issuer])).decode()
+
+    li_full = leaflib.encode_leaf_input(leaf, timestamp_ms=1)
+    cases = []
+    # over-padded base64
+    cases.append(("QUJD====", ok_ed))
+    # extensions<2> frame missing entirely
+    cases.append((base64.b64encode(li_full[:-2]).decode(), ok_ed))
+    # extensions length pointing past the buffer
+    trunc = li_full[:-2] + b"\x00\x10"
+    cases.append((base64.b64encode(trunc).decode(), ok_ed))
+    # chain frame length exceeding extra_data
+    bad_frame = (len(issuer) + 100).to_bytes(3, "big") + issuer
+    cases.append((base64.b64encode(li_full).decode(),
+                  base64.b64encode(bad_frame).decode()))
+    # second chain cert truncated
+    inner = (len(issuer).to_bytes(3, "big") + issuer
+             + (500).to_bytes(3, "big") + b"\x01\x02")
+    bad2 = len(inner).to_bytes(3, "big") + inner
+    cases.append((base64.b64encode(li_full).decode(),
+                  base64.b64encode(bad2).decode()))
+    # zero-length chain[0]
+    empty0 = (3).to_bytes(3, "big") + (0).to_bytes(3, "big")
+    cases.append((base64.b64encode(li_full).decode(),
+                  base64.b64encode(empty0).decode()))
+
+    lis = [c[0] for c in cases]
+    eds = [c[1] for c in cases]
+    nat = leafpack.decode_raw_batch(lis, eds, pad_len=2048)
+    py = leafpack._decode_python(lis, eds, pad_len=2048)
+    np.testing.assert_array_equal(nat.status, py.status)
+    np.testing.assert_array_equal(nat.data, py.data)
+    assert nat.issuers == py.issuers
+    # and none of these were silently accepted as fully OK
+    assert (nat.status != leafpack.OK).all()
+
+
+@pytest.mark.skipif(not available(), reason="no C++ compiler")
 def test_native_too_long_flagged():
     lis, eds, expect, issuer = _wire_batch()
     nat = leafpack.decode_raw_batch(lis[:1], eds[:1], pad_len=64)
