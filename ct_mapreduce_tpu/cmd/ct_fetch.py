@@ -154,6 +154,18 @@ def main(argv: list[str] | None = None) -> int:
         )
         printer.start()
 
+    profiling = False
+    if config.profile_dir:
+        # SURVEY.md §5 tracing analog: a jax.profiler trace of the run
+        # (device steps + host phases) next to the metric timers.
+        try:
+            import jax
+
+            jax.profiler.start_trace(config.profile_dir)
+            profiling = True
+        except Exception as err:
+            print(f"profiling disabled: {err}", file=sys.stderr)
+
     final_round_errors = False
     try:
         while True:
@@ -179,6 +191,15 @@ def main(argv: list[str] | None = None) -> int:
             if engine.stop_event.wait(delay):
                 break
     finally:
+        if profiling:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as err:
+                # Trace serialization failures must not mask the real
+                # exception or skip the remaining shutdown steps.
+                print(f"profiler stop failed: {err}", file=sys.stderr)
         if printer:
             printer.stop()
         if health:

@@ -53,6 +53,7 @@ class CTConfig:
     mesh_shape: str = ""  # e.g. "data:4,expert:2"; empty = all devices on data
     device_queue_depth: int = 2
     agg_state_path: str = ""  # .npz snapshot of device aggregates (tpu backend)
+    profile_dir: str = ""  # jax.profiler trace output dir (empty = off)
     verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
@@ -82,6 +83,7 @@ class CTConfig:
         "meshShape": ("mesh_shape", str),
         "deviceQueueDepth": ("device_queue_depth", int),
         "aggStatePath": ("agg_state_path", str),
+        "profileDir": ("profile_dir", str),
     }
 
     @classmethod
@@ -223,6 +225,7 @@ class CTConfig:
             "meshShape = device mesh, e.g. data:4,expert:2",
             "deviceQueueDepth = host->device prefetch depth",
             "aggStatePath = Path for the on-device aggregate snapshot (.npz)",
+            "profileDir = Write a jax.profiler trace of the run here",
         ]
         return "\n".join(lines)
 
