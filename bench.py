@@ -32,6 +32,7 @@ import functools
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -39,6 +40,101 @@ import numpy as np
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+class BenchError(RuntimeError):
+    """Raised for any bench failure; __main__ turns it into the
+    structured one-line JSON the driver can parse."""
+
+
+_emit_lock = threading.Lock()
+_emitted = False
+
+
+def emit(payload: dict) -> bool:
+    """Print the single stdout JSON line, exactly once per process."""
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            return False
+        _emitted = True
+        print(json.dumps(payload), flush=True)
+        return True
+
+
+def emit_error(msg: str) -> bool:
+    return emit({
+        "metric": "ct_entries_per_sec_per_chip",
+        "value": 0,
+        "unit": "entries/s/chip",
+        "vs_baseline": 0,
+        "error": msg[:500],
+    })
+
+
+def start_watchdog(budget_s: float) -> None:
+    """Force-exit with a parseable error JSON if the whole bench
+    doesn't finish inside ``budget_s`` — a hung backend init or compile
+    on the tunneled TPU must yield rc=1 + JSON, never the driver's
+    rc=124 with nothing on stdout (round 1/2 failure mode)."""
+    def fire() -> None:
+        time.sleep(budget_s)
+        if emit_error(f"bench watchdog: exceeded {budget_s:.0f}s budget"):
+            log(f"watchdog fired after {budget_s:.0f}s; force-exiting")
+            sys.stderr.flush()
+            os._exit(1)
+
+    threading.Thread(target=fire, daemon=True, name="bench-watchdog").start()
+
+
+def acquire_device(max_attempts: int = 4, attempt_timeout_s: float = 90.0):
+    """First device, surviving backend-init failure AND hang.
+
+    The tunneled TPU backend has shown two failure modes at init:
+    ``UNAVAILABLE: TPU backend setup/compile error`` (round 1, rc=1)
+    and an outright hang (round 2 testing, rc=124). Each attempt runs
+    in a watchdog thread with a timeout; failures get bounded
+    retry-with-backoff — mirroring the reference's transient-failure
+    tolerance on its hot path (/root/reference/cmd/ct-fetch/
+    ct-fetch.go:409-437: jittered backoff + retry on 429).
+    """
+    delay = 2.0
+    last_err: Exception | None = None
+    for attempt in range(1, max_attempts + 1):
+        result: dict = {}
+
+        def target() -> None:
+            try:
+                import jax
+
+                result["dev"] = jax.devices()[0]
+            except Exception as err:  # RuntimeError / JaxRuntimeError
+                result["err"] = err
+
+        t = threading.Thread(target=target, daemon=True, name="backend-init")
+        t.start()
+        t.join(attempt_timeout_s)
+        if "dev" in result:
+            return result["dev"]
+        if t.is_alive():
+            last_err = TimeoutError(
+                f"backend init hung > {attempt_timeout_s:.0f}s"
+            )
+        else:
+            last_err = result.get("err") or RuntimeError("no device")
+        log(f"backend init attempt {attempt}/{max_attempts} failed: "
+            f"{type(last_err).__name__}: {last_err}")
+        try:
+            import jax._src.xla_bridge as xb
+
+            xb._clear_backends()
+        except Exception:
+            pass
+        if attempt < max_attempts:
+            time.sleep(delay)
+            delay = min(delay * 2, 30.0)
+    raise BenchError(f"backend unavailable after {max_attempts} attempts: "
+                     f"{type(last_err).__name__}: {last_err}")
 
 
 def main() -> int:
@@ -60,11 +156,13 @@ def main() -> int:
     # bounded so probe behavior stays representative.
     max_entries = (max_sweeps + 1) * n_batches * batch
     if max_entries > capacity * 0.6:
-        log(f"capacity {capacity} too small for {max_entries} unique "
-            f"entries; raise CT_BENCH_LOG2_CAPACITY or lower sweeps")
-        return 1
+        raise BenchError(
+            f"capacity {capacity} too small for {max_entries} unique "
+            f"entries; raise CT_BENCH_LOG2_CAPACITY or lower sweeps"
+        )
 
-    dev = jax.devices()[0]
+    start_watchdog(float(os.environ.get("CT_BENCH_WATCHDOG_SECS", "540")))
+    dev = acquire_device()
     log(f"device: {dev.platform} ({dev.device_kind}); batch={batch} "
         f"resident={n_batches} pad={pad_len} capacity={capacity}")
 
@@ -145,18 +243,35 @@ def main() -> int:
     log(f"processed={processed} in {elapsed:.3f}s; fresh={total_fresh} "
         f"host_lane={total_host} table_count={final_count} expected={expected}")
     if final_count != expected or total_fresh != processed or total_host != 0:
-        log("PARITY FAILURE: dedup table does not match unique-entry count")
-        return 1
+        raise BenchError(
+            "PARITY FAILURE: dedup table does not match unique-entry count: "
+            f"table_count={final_count} expected={expected} "
+            f"fresh={total_fresh} host_lane={total_host}"
+        )
 
     rate = processed / elapsed
-    print(json.dumps({
+    emit({
         "metric": "ct_entries_per_sec_per_chip",
         "value": round(rate, 1),
         "unit": "entries/s/chip",
         "vs_baseline": round(rate / 10_000_000, 4),
-    }))
+    })
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # Whatever happens, stdout carries exactly one JSON line: a real
+    # metric on success, a structured {"error": ...} on failure — never
+    # a bare traceback (round 1's rc=1 left the driver nothing to parse).
+    try:
+        rc = main()
+    except SystemExit:
+        raise
+    except Exception as err:
+        msg = f"{type(err).__name__}: {err}"
+        emit_error(msg)
+        log(msg)
+        # A hung backend-init thread must not block interpreter exit.
+        sys.stderr.flush()
+        os._exit(1)
+    sys.exit(rc)

@@ -32,7 +32,10 @@ the host sets, and per-issuer CRL/DN sets.
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
+import tempfile
 import threading
 from dataclasses import dataclass, field
 from datetime import datetime, timezone
@@ -487,13 +490,37 @@ class TpuAggregator:
         The log cursor itself is checkpointed separately (same contract
         as the reference, /root/reference/storage/types.go:25-42); this
         file makes device state restorable after preemption.
+
+        Written via temp-file + ``os.replace`` so a crash mid-write
+        never corrupts the previous good snapshot (the cursor may point
+        past entries recorded only here, so losing it would drop
+        aggregates permanently), and through an open file object so the
+        snapshot lands at *exactly* the configured path — numpy would
+        otherwise silently append ``.npz``, breaking the resume and
+        --backend=tpu lookups that check the bare path.
         """
         host_items = [
             (idx, eh, b";".join(s.hex().encode() for s in sorted(serials)))
             for (idx, eh), serials in self.host_serials.items()
         ]
+        directory = os.path.dirname(os.path.abspath(path))
+        fd, tmp_path = tempfile.mkstemp(
+            prefix=os.path.basename(path) + ".tmp.", dir=directory
+        )
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                self._write_npz(fh, host_items)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp_path, path)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp_path)
+            raise
+
+    def _write_npz(self, fh, host_items) -> None:
         np.savez_compressed(
-            path,
+            fh,
             keys=np.asarray(self.table.keys),
             meta=np.asarray(self.table.meta),
             count=np.asarray(self.table.count),

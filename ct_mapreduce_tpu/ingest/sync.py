@@ -379,9 +379,33 @@ class LogWorker:
     ) -> int:
         """Stream ``[start_pos, end_pos]`` into the queue; returns the
         number of entries enqueued. Checkpoints on a ticker and at exit
-        (ct-fetch.go:360-368,472-473). With ``raw_batches``, whole
-        get-entries responses are enqueued undecoded for the sink's
-        native batch decoder."""
+        (ct-fetch.go:360-368,472-473) — the exit save runs on error
+        paths too, like the reference's deferred save (ct-fetch.go:367):
+        a transport error mid-range must not discard up to a full save
+        period of cursor progress (re-fetch is dedup-safe, but it is
+        lost work). With ``raw_batches``, whole get-entries responses
+        are enqueued undecoded for the sink's native batch decoder."""
+        try:
+            enqueued = self._run_loop(
+                out, stop, save_period_s, progress, raw_batches
+            )
+        except BaseException:
+            # Best-effort save on the error path: a failing save must
+            # not replace the root-cause download error (the engine
+            # records what propagates to it).
+            try:
+                self.save_state()
+            except Exception:
+                metrics.incr_counter(
+                    "LogWorker", self.client.short_url, "saveStateError"
+                )
+            raise
+        self.save_state()
+        return enqueued
+
+    def _run_loop(
+        self, out, stop, save_period_s, progress, raw_batches
+    ) -> int:
         enqueued = 0
         next_save = time.monotonic() + save_period_s
         index = self.position
@@ -477,8 +501,21 @@ class LogWorker:
                     next_save = time.monotonic() + save_period_s
                 if stop.is_set():
                     break
-        self.save_state()
         return enqueued
+
+
+class _AccountingQueue:
+    """Facade over the shared entry queue that bumps the engine's
+    per-log outstanding watermark on each successful put (the blocking
+    semantics are the inner queue's own)."""
+
+    def __init__(self, inner: "queue.Queue", on_put):
+        self._inner = inner
+        self._on_put = on_put
+
+    def put(self, item, timeout=None) -> None:
+        self._inner.put(item, timeout=timeout)
+        self._on_put(item)
 
 
 class LogSyncEngine:
@@ -524,6 +561,12 @@ class LogSyncEngine:
         self._last_updates: dict[str, datetime] = {}
         self._progress: dict[str, tuple[int, int]] = {}
         self.errors: list[str] = []
+        # Per-log count of entries enqueued but not yet through the sink.
+        # A durable cursor save for log L only needs L's own entries
+        # stored — waiting on the whole shared queue (entry_queue.join())
+        # would let other logs' downloaders starve the save indefinitely.
+        self._outstanding: dict[str, int] = {}
+        self._outstanding_cond = threading.Condition()
 
     # -- health surface (ct-fetch.go:567-597) ---------------------------
     def last_updates(self) -> dict[str, datetime]:
@@ -563,6 +606,8 @@ class LogSyncEngine:
                     self.errors.append(f"store {where}: {err}")
             finally:
                 self.entry_queue.task_done()
+                if item is not None:
+                    self._account_stored(item)
 
     def start_store_threads(self) -> None:
         for i in range(self.num_threads):
@@ -572,10 +617,31 @@ class LogSyncEngine:
             t.start()
             self._store_threads.append(t)
 
-    def _pre_cursor_save(self) -> None:
-        """Make everything the cursor covers durable: wait out the
-        queue (enqueued ⇒ stored), then run the checkpoint hook."""
-        self.entry_queue.join()
+    def _account_enqueued(self, item) -> None:
+        n = len(item) if isinstance(item, RawBatch) else 1
+        with self._outstanding_cond:
+            self._outstanding[item.log_url] = (
+                self._outstanding.get(item.log_url, 0) + n
+            )
+
+    def _account_stored(self, item) -> None:
+        n = len(item) if isinstance(item, RawBatch) else 1
+        with self._outstanding_cond:
+            self._outstanding[item.log_url] = (
+                self._outstanding.get(item.log_url, 0) - n
+            )
+            self._outstanding_cond.notify_all()
+
+    def _pre_cursor_save(self, log_url: str) -> None:
+        """Make everything log ``log_url``'s cursor covers durable:
+        wait until every entry *this log* enqueued has passed through
+        the sink (a per-log watermark — the downloader is the one
+        waiting, so its count only drains; other logs keep flowing),
+        then run the checkpoint hook to flush + snapshot."""
+        with self._outstanding_cond:
+            self._outstanding_cond.wait_for(
+                lambda: self._outstanding.get(log_url, 0) <= 0
+            )
         if self.checkpoint_hook is not None:
             self.checkpoint_hook()
 
@@ -586,11 +652,13 @@ class LogSyncEngine:
                 client = CTLogClient(log_url, transport=transport)
                 worker = LogWorker(
                     client, self.database, offset=self.offset, limit=self.limit,
-                    pre_save=self._pre_cursor_save,
+                    # Items carry the client's normalized URL, so the
+                    # watermark key must match it.
+                    pre_save=lambda: self._pre_cursor_save(client.log_url),
                 )
                 self._note_progress(client.short_url, worker.position, worker.end_pos)
                 worker.run(
-                    self.entry_queue,
+                    _AccountingQueue(self.entry_queue, self._account_enqueued),
                     self.stop_event,
                     save_period_s=self.save_period_s,
                     progress=self._note_progress,

@@ -8,6 +8,7 @@ checkpoint (ct-fetch.go:288-305), tolerate-bad-entries
 """
 
 import datetime
+import queue
 import threading
 
 import pytest
@@ -368,3 +369,34 @@ def test_health_http_server():
 def test_polling_delay_positive():
     for _ in range(100):
         assert polling_delay(600.0, 10) >= 1.0
+
+
+def test_cursor_saved_on_download_error():
+    """A transport failure mid-range must still run the exit state save
+    (reference saves on error paths too, ct-fetch.go:367): progress up
+    to the failure survives; re-fetch of the failed range is dedup-safe."""
+    from ct_mapreduce_tpu.ingest.ctclient import CTClientError
+
+    log = FakeLog()
+    leaf, issuer = _leaf_and_issuer(5)
+    for _ in range(6):
+        log.add_cert(leaf, issuer)
+    log.max_batch = 2  # 3 get-entries requests for the full range
+
+    calls = {"n": 0}
+
+    def failing_transport(url):
+        if "get-entries" in url:
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                return 500, {}, b"transport down"
+        return log.transport(url)
+
+    db = _db()
+    c = CTLogClient(log.url, transport=failing_transport)
+    w = LogWorker(c, db)
+    q = queue.Queue()
+    with pytest.raises(CTClientError):
+        w.run(q, threading.Event(), save_period_s=1e9)
+    st = db.get_log_state("ct.example.com/fake")
+    assert st.max_entry == 2  # first batch durable, not lost

@@ -2,6 +2,8 @@
 the config→model composition root (models.build_aggregator)."""
 
 import datetime
+import os
+import threading
 
 import jax
 import numpy as np
@@ -240,3 +242,69 @@ def test_ingest_model_from_config(tmp_path):
 
     model2 = IngestModel.from_config(cfg)
     assert model2.drain().total == model.drain().total == 4
+
+
+def test_checkpoint_atomic_and_exact_path(tmp_path):
+    """Snapshot writes are temp+rename: a crash mid-write leaves the
+    previous good snapshot intact, and the file lands at EXACTLY the
+    configured path (numpy's silent '.npz' suffixing would break the
+    bare-path resume/report lookups)."""
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+
+    path = str(tmp_path / "agg.state")  # deliberately no .npz suffix
+    agg = TpuAggregator(capacity=1 << 12, batch_size=32, now=NOW)
+    agg.ingest(_entries(n_issuers=2))
+    agg.save_checkpoint(path)
+    assert os.path.exists(path)  # exact path, no suffix appended
+    good = open(path, "rb").read()
+
+    # Crash mid-write: the inner writer dies after the temp file opens.
+    def boom(fh, host_items):
+        fh.write(b"partial garbage")
+        raise RuntimeError("simulated crash mid-save")
+
+    agg2 = TpuAggregator(capacity=1 << 12, batch_size=32, now=NOW)
+    agg2.ingest(_entries(n_issuers=1))
+    agg2._write_npz = boom
+    with pytest.raises(RuntimeError):
+        agg2.save_checkpoint(path)
+    assert open(path, "rb").read() == good  # previous snapshot survives
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+    restored = TpuAggregator(capacity=1 << 12, batch_size=32, now=NOW)
+    restored.load_checkpoint(path)
+    assert restored.drain().counts == agg.drain().counts
+
+
+def test_pre_cursor_save_not_starved_by_other_logs():
+    """A periodic cursor save for log A must not wait on log B's
+    in-flight entries (the old global entry_queue.join() could be
+    starved indefinitely by other downloaders)."""
+    from ct_mapreduce_tpu.ingest.sync import LogSyncEngine, _QueueItem
+
+    class _NullSink:
+        def store(self, entry, log_url):
+            pass
+
+        def flush(self):
+            pass
+
+    engine = LogSyncEngine(_NullSink(), database=None, num_threads=1)
+
+    class _E:
+        index = 0
+
+    # Log B has an item sitting unprocessed in the shared queue (no
+    # store threads running) — under join() semantics this would block.
+    item_b = _QueueItem(_E(), "https://b.example.com/log")
+    engine.entry_queue.put(item_b)
+    engine._account_enqueued(item_b)
+
+    done = threading.Event()
+    t = threading.Thread(
+        target=lambda: (engine._pre_cursor_save("https://a.example.com/log"),
+                        done.set()),
+        daemon=True,
+    )
+    t.start()
+    assert done.wait(timeout=5.0), "save for log A starved by log B's backlog"
