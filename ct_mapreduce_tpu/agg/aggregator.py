@@ -119,6 +119,53 @@ class IngestResult:
     host_lane_count: int = 0
 
 
+class PendingIngest:
+    """The async half of :meth:`TpuAggregator.ingest_packed`.
+
+    Device work for every chunk has been DISPATCHED (JAX dispatch is
+    asynchronous; the steps chain in submission order on the donated
+    table state), but no result has been read back. ``complete()``
+    performs the D2H reads and the exact host-lane work and returns the
+    :class:`IngestResult`.
+
+    This is the TPU analog of the reference's download→store pipeline
+    overlap (goroutines + a 16,384-slot channel,
+    /root/reference/cmd/ct-fetch/ct-fetch.go:132,398-488): while the
+    device chews on batch N, the host decodes and packs batch N+1
+    instead of blocking on N's readback.
+    """
+
+    def __init__(self, agg: "TpuAggregator", chunks, res: IngestResult,
+                 data: np.ndarray, length: np.ndarray) -> None:
+        self._agg = agg
+        self._chunks = chunks  # [(batch, device_pos, lane_of, out)]
+        self._res = res
+        self._data = data
+        self._length = length
+        self._done = False
+
+    def complete(self) -> IngestResult:
+        if self._done:
+            return self._res
+        self._done = True
+        agg = self._agg
+        with contextlib.suppress(ValueError):
+            agg._outstanding.remove(self)
+        res = self._res
+        host_lane_total = 0
+        for batch, device_pos, lane_of, out in self._chunks:
+            host_pos = agg._consume_out(batch, out, device_pos, res, lane_of)
+            host_lane_total += agg._host_lanes(
+                host_pos,
+                lambda pos: self._data[pos, : self._length[pos]].tobytes(),
+                res,
+            )
+        agg.metrics["host_lane"] += host_lane_total
+        res.host_lane_count = host_lane_total
+        incr_counter("aggregator", "batches")
+        return res
+
+
 @dataclass
 class AggregateSnapshot:
     """Drained reduce state — the material of storage-statistics."""
@@ -161,10 +208,13 @@ class TpuAggregator:
         self._dn_raw_seen: set[tuple[int, bytes]] = set()
         # Device-side per-issuer unknown totals (running).
         self.issuer_totals = np.zeros((packing.MAX_ISSUERS,), np.int64)
+        # Submitted-but-not-completed pipelined ingests (FIFO).
+        self._outstanding: list[PendingIngest] = []
         self.set_cn_prefixes(cn_prefixes)
         self.metrics: dict[str, int] = {
             "inserted": 0, "known": 0, "filtered_ca": 0, "filtered_expired": 0,
             "filtered_cn": 0, "host_lane": 0, "parse_errors": 0, "overflow": 0,
+            "dispatch_spill": 0,
         }
 
     # -- state hooks (overridden by the mesh-sharded subclass) -----------
@@ -173,6 +223,15 @@ class TpuAggregator:
 
     def _drain_table(self) -> tuple[np.ndarray, np.ndarray]:
         return hashtable.drain_np(self.table)
+
+    def _device_contains(self, fps: np.ndarray) -> np.ndarray:
+        """bool[n]: are these fingerprints present in the device table?"""
+        import jax.numpy as jnp
+
+        return np.asarray(
+            hashtable.contains(self.table, jnp.asarray(fps),
+                               max_probes=self.max_probes),
+        )
 
     # -- config ----------------------------------------------------------
     def set_cn_prefixes(self, prefixes: tuple[str, ...]) -> None:
@@ -240,7 +299,25 @@ class TpuAggregator:
         native batch decoder) go straight to the device, no per-entry
         Python objects. ``issuer_idx`` are registry indices
         (:meth:`IssuerRegistry.get_or_assign`); invalid lanes are
-        ignored. Host-lane fallbacks slice their DER from ``data``."""
+        ignored. Host-lane fallbacks slice their DER from ``data``.
+
+        Synchronous form: submit + immediate complete. Pipelined
+        callers use :meth:`ingest_packed_submit` and defer
+        ``complete()`` by ``deviceQueueDepth`` batches."""
+        return self.ingest_packed_submit(data, length, issuer_idx,
+                                         valid).complete()
+
+    def ingest_packed_submit(
+        self,
+        data: np.ndarray,
+        length: np.ndarray,
+        issuer_idx: np.ndarray,
+        valid: np.ndarray,
+    ) -> PendingIngest:
+        """Dispatch the device steps for a packed batch WITHOUT reading
+        anything back. Returns a :class:`PendingIngest`; until its
+        ``complete()`` runs, the device computes while the host is free
+        to decode/pack the next batch (SURVEY §2.2 PP row)."""
         n = int(data.shape[0])
         res = IngestResult(
             was_unknown=np.zeros((n,), bool),
@@ -249,7 +326,7 @@ class TpuAggregator:
             serials=[None] * n,
             issuer_idx=np.asarray(issuer_idx, np.int32).copy(),
         )
-        host_lane_total = 0
+        chunks = []
         for start in range(0, n, self.batch_size):
             end = min(start + self.batch_size, n)
             m = end - start
@@ -274,28 +351,32 @@ class TpuAggregator:
             # only when every lane is valid; map explicitly otherwise.
             if len(device_pos) != m:
                 lane_of_pos = {start + j: j for j in range(m)}
+                lane_of = lambda pos, _m=lane_of_pos: _m[pos]  # noqa: E731
             else:
-                lane_of_pos = None
-            host_pos = self._consume_chunk(
-                batch, device_pos, res,
-                lane_of=(None if lane_of_pos is None
-                         else lambda pos: lane_of_pos[pos]),
-            )
-            host_lane_total += self._host_lanes(
-                host_pos,
-                lambda pos: data[pos, : length[pos]].tobytes(),
-                res,
-            )
-        self.metrics["host_lane"] += host_lane_total
-        res.host_lane_count = host_lane_total
-        incr_counter("aggregator", "batches")
-        return res
+                lane_of = None
+            out = self._device_step_packed(batch)  # async dispatch
+            chunks.append((batch, device_pos, lane_of, out))
+        pending = PendingIngest(self, chunks, res, data, length)
+        self._outstanding.append(pending)
+        return pending
+
+    def complete_outstanding(self) -> None:
+        """Fold every un-completed submit into host state (FIFO). Any
+        reader of aggregate state (drain, checkpoint) calls this first
+        so pipelining can never lose in-flight results."""
+        while self._outstanding:
+            self._outstanding[0].complete()
 
     def _consume_chunk(self, batch, device_pos, res, lane_of=None):
         """Run one packed chunk on device and fold the outputs into
         ``res`` at the global positions ``device_pos``. Returns the
         positions that must take the exact host lane."""
         out = self._device_step_packed(batch)
+        return self._consume_out(batch, out, device_pos, res, lane_of)
+
+    def _consume_out(self, batch, out, device_pos, res, lane_of=None):
+        """Read back one chunk's device outputs and fold them into
+        ``res``; the blocking half of the step."""
         hl = np.asarray(out.host_lane)
         wu = np.asarray(out.was_unknown)
         nah = np.asarray(out.not_after_hour)
@@ -311,6 +392,9 @@ class TpuAggregator:
             np.asarray(out.filtered_expired).sum()
         )
         self.metrics["filtered_cn"] += int(np.asarray(out.filtered_cn).sum())
+        dropped = getattr(out, "dispatch_dropped", None)
+        if dropped is not None:  # sharded path: routing-cap spill rate
+            self.metrics["dispatch_spill"] += int(np.asarray(dropped).sum())
         self.issuer_totals += np.asarray(out.issuer_unknown_counts, np.int64)
 
         host_pos = []
@@ -343,13 +427,31 @@ class TpuAggregator:
         return host_pos
 
     def _host_lanes(self, host_pos, der_of, res) -> int:
-        """Exact host path for flagged + oversized lanes."""
+        """Exact host path for flagged + oversized lanes.
+
+        Two phases so the cross-domain device-membership guard is ONE
+        batched ``contains`` probe per chunk (each probe pays the full
+        per-execution readback toll on the tunneled stack — per-cert
+        probing would erode the pipelining the sink provides)."""
+        staged = []  # (pos, fields, eh) — lanes that reached dedup
         for pos in host_pos:
-            u, f, eh, sb = self._host_exact(
-                der_of(pos), int(res.issuer_idx[pos])
+            fields, x = self._host_filter(der_of(pos), int(res.issuer_idx[pos]))
+            if fields is None:
+                u, f, eh, sb = x
+                res.was_unknown[pos], res.filtered[pos] = u, f
+                res.exp_hours[pos], res.serials[pos] = eh, sb
+            else:
+                staged.append((pos, fields, x))
+        flags = self._device_known_flags(
+            [(int(res.issuer_idx[pos]), eh, fields.serial)
+             for pos, fields, eh in staged]
+        )
+        for (pos, fields, eh), dk in zip(staged, flags):
+            u, f, eh2, sb = self._host_dedup(
+                fields, int(res.issuer_idx[pos]), eh, device_known=dk
             )
             res.was_unknown[pos], res.filtered[pos] = u, f
-            res.exp_hours[pos], res.serials[pos] = eh, sb
+            res.exp_hours[pos], res.serials[pos] = eh2, sb
         return len(host_pos)
 
     def _device_step_packed(self, batch):
@@ -415,30 +517,66 @@ class TpuAggregator:
             if parsed.scheme in ("http", "https"):
                 self.crl_sets.setdefault(issuer_idx, set()).add(parsed.geturl())
 
-    def _host_exact(self, der: bytes, issuer_idx: int):
-        """The exact lane: tolerant host parse + reference filter +
-        host-set dedup. Returns (was_unknown, filtered, exp_hour, serial)."""
+    def _host_filter(self, der: bytes, issuer_idx: int):
+        """Tolerant host parse + reference filters. Returns
+        ``(fields, exp_hour)`` when the lane reaches dedup, else
+        ``(None, (was_unknown, filtered, exp_hour, serial))``."""
         try:
             fields = hostder.parse_cert(der)
         except Exception:
             self.metrics["parse_errors"] += 1
-            return False, False, 0, None
-        now_hour = self._now_hour()
+            return None, (False, False, 0, None)
         if fields.is_ca:
             self.metrics["filtered_ca"] += 1
-            return False, True, 0, None
+            return None, (False, True, 0, None)
         eh = fields.not_after_unix_hour
-        if eh < now_hour:
+        # Exact instant compare, like the reference's NotAfter.Before(now)
+        # (/root/reference/cmd/ct-fetch/ct-fetch.go:52-55). The device
+        # lane handles whole-bucket cases and routes the boundary bucket
+        # (expiring this hour) here, so this compare is what decides it.
+        now = self._fixed_now or datetime.now(timezone.utc)
+        if fields.not_after < now:
             self.metrics["filtered_expired"] += 1
-            return False, True, 0, None
+            return None, (False, True, 0, None)
         if self.cn_prefixes and not any(
             fields.issuer_cn.startswith(p) for p in self.cn_prefixes
         ):
             self.metrics["filtered_cn"] += 1
-            return False, True, 0, None
+            return None, (False, True, 0, None)
+        return fields, eh
+
+    def _device_known_flags(self, items) -> list[bool]:
+        """Cross-domain guard, mirror of the device→host check in
+        `_consume_out`: a lane can migrate into the host domain over
+        time (a cert entering its expiry hour is boundary-routed here;
+        a table filling up overflows here), so a serial already counted
+        in the DEVICE table must not count again. One batched membership
+        probe for the whole chunk, no mutation.
+
+        items: [(issuer_idx, exp_hour, serial_bytes)] → bool per item.
+        """
+        flags = [False] * len(items)
+        cand, fps = [], []
+        for j, (issuer_idx, eh, serial) in enumerate(items):
+            if (
+                len(serial) <= packing.MAX_SERIAL_BYTES
+                and 0 <= issuer_idx < packing.MAX_ISSUERS
+                and 0 <= eh - self.base_hour < packing.META_HOUR_SPAN
+            ):
+                cand.append(j)
+                fps.append(packing.fingerprint_host(issuer_idx, eh, serial))
+        if fps:
+            known = self._device_contains(np.array(fps, np.uint32))
+            for j, k in zip(cand, known):
+                flags[j] = bool(k)
+        return flags
+
+    def _host_dedup(self, fields, issuer_idx: int, eh: int,
+                    device_known: bool = False):
+        """Host-set dedup + metadata accumulation for a filtered lane."""
         key = (issuer_idx, eh)
         bucket = self.host_serials.setdefault(key, set())
-        if fields.serial in bucket:
+        if fields.serial in bucket or device_known:
             self.metrics["known"] += 1
             return False, False, eh, fields.serial
         bucket.add(fields.serial)
@@ -449,11 +587,21 @@ class TpuAggregator:
         self._add_crls(issuer_idx, fields.crl_distribution_points)
         return True, False, eh, fields.serial
 
+    def _host_exact(self, der: bytes, issuer_idx: int):
+        """The exact lane for one cert: filter + batched-of-one guard +
+        dedup. Returns (was_unknown, filtered, exp_hour, serial)."""
+        fields, x = self._host_filter(der, issuer_idx)
+        if fields is None:
+            return x
+        dk = self._device_known_flags([(issuer_idx, x, fields.serial)])[0]
+        return self._host_dedup(fields, issuer_idx, x, device_known=dk)
+
     # -- drain / report --------------------------------------------------
     def drain(self) -> AggregateSnapshot:
         """Pull device state to host and merge with the host lane —
         the data storage-statistics prints
         (/root/reference/cmd/storage-statistics/storage-statistics.go:28-99)."""
+        self.complete_outstanding()
         _, meta = self._drain_table()
         counts: dict[tuple[str, str], int] = {}
         if meta.size:
@@ -499,6 +647,7 @@ class TpuAggregator:
         otherwise silently append ``.npz``, breaking the resume and
         --backend=tpu lookups that check the bare path.
         """
+        self.complete_outstanding()
         host_items = [
             (idx, eh, b";".join(s.hex().encode() for s in sorted(serials)))
             for (idx, eh), serials in self.host_serials.items()

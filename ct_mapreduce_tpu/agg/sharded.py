@@ -67,13 +67,54 @@ class ShardedStepOut(NamedTuple):
     crldp_len: jax.Array
     issuer_name_off: jax.Array
     issuer_name_len: jax.Array
+    dispatch_dropped: jax.Array  # bool[B] — lane spilled past the
+    # per-(src,dst) routing cap to the exact host lane (surfaced as the
+    # aggregator's `dispatch_spill` metric so routing skew is observable)
 
 
 def _shard_of(keys: jax.Array, n_shards: int) -> jax.Array:
     """Home shard of each fingerprint — independent bits from the slot
-    hash so shard routing doesn't correlate with in-shard probing."""
+    hash so shard routing doesn't correlate with in-shard probing.
+
+    Routing is a function of the WHOLE fingerprint (expHour, issuerID,
+    serial): because serials differ per certificate, even a single hot
+    issuer (Zipfian reality of CT logs) spreads uniformly over shards —
+    spills past the per-(src,dst) cap are binomial-tail events, not
+    hot-key events (pinned by test_sharded_zipfian_issuer_skew)."""
     h = keys[:, 2] ^ (keys[:, 3] * np.uint32(0x85EBCA6B))
     return (h % np.uint32(n_shards)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("n_shards", "max_probes"))
+def _contains_global(
+    table_keys: jax.Array, keys: jax.Array,
+    n_shards: int, max_probes: int,
+) -> jax.Array:
+    """Membership over the globally-viewed sharded table: shard-of-key
+    addressing + the local triangular probe, as one gather-only jit (no
+    shard_map — XLA inserts any needed collectives for the gathers)."""
+    capacity = table_keys.shape[0]
+    cap_loc = capacity // n_shards
+    keys = hashtable._desentinel(keys.astype(jnp.uint32))
+    dest = _shard_of(keys, n_shards)
+    home = hashtable._home_slot(keys, cap_loc)
+    b = keys.shape[0]
+
+    def round_body(r, carry):
+        found, open_ = carry
+        slot = dest * cap_loc + ((home + (r * (r + 1)) // 2) & (cap_loc - 1))
+        cur = table_keys[slot]
+        match = jnp.all(cur == keys, axis=-1)
+        empty = jnp.all(cur == 0, axis=-1)
+        found = found | (match & open_)
+        open_ = open_ & ~match & ~empty
+        return found, open_
+
+    found, _ = jax.lax.fori_loop(
+        0, max_probes, round_body,
+        (jnp.zeros((b,), bool), jnp.ones((b,), bool)),
+    )
+    return found
 
 
 def _dispatch(
@@ -194,6 +235,7 @@ def _local_step(
             crldp_len=parsed.crldp_len,
             issuer_name_off=parsed.issuer_off,
             issuer_name_len=parsed.issuer_len,
+            dispatch_dropped=dispatch_dropped,
         ),
     )
 
@@ -287,6 +329,7 @@ class ShardedDedup:
                     issuer_unknown_counts=P(),
                     has_crldp=A, crldp_off=A, crldp_len=A,
                     issuer_name_off=A, issuer_name_len=A,
+                    dispatch_dropped=A,
                 ),
             ),
             check_vma=False,
@@ -391,6 +434,21 @@ class ShardedDedup:
 
     def total_count(self) -> int:
         return int(jnp.sum(self.count))
+
+    def contains_np(self, fps_np: np.ndarray) -> np.ndarray:
+        """Batched membership probe against the sharded table.
+
+        Mirrors the sharded insert addressing exactly: home shard from
+        `_shard_of`, then the local triangular probe within that
+        shard's row block (each shard's `hashtable.insert` runs on its
+        local slice, so local capacity masks the slot). Used by the
+        host lane's cross-domain dedup guard."""
+        if fps_np.size == 0:
+            return np.zeros((0,), bool)
+        return np.asarray(_contains_global(
+            self.keys, jnp.asarray(fps_np.astype(np.uint32)),
+            n_shards=self.n_shards, max_probes=self.max_probes,
+        ))
 
     def drain_np(self) -> tuple[np.ndarray, np.ndarray]:
         return hashtable.drain_np(

@@ -61,6 +61,9 @@ class ShardedAggregator(TpuAggregator):
     def _drain_table(self) -> tuple[np.ndarray, np.ndarray]:
         return self.dedup.drain_np()
 
+    def _device_contains(self, fps: np.ndarray) -> np.ndarray:
+        return self.dedup.contains_np(fps)
+
     def _device_step_packed(self, batch):
         return self.dedup.step(
             np.asarray(batch.data),

@@ -82,7 +82,8 @@ def build_sink(config: CTConfig, database, backend=None):
         pem_backend = backend if config.cert_path else None
         return AggregatorSink(model.aggregator,
                               flush_size=config.batch_size,
-                              backend=pem_backend), model
+                              backend=pem_backend,
+                              device_queue_depth=config.device_queue_depth), model
     sink = DatabaseSink(
         database,
         cn_filters=tuple(config.issuer_cn_filters()),

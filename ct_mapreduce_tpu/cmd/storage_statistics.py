@@ -20,10 +20,24 @@ from ct_mapreduce_tpu.engine import get_configured_storage, prepare_telemetry
 
 
 def report_from_tpu_snapshot(config: CTConfig, out, verbosity: int = 0) -> int:
-    """Drain path: aggregate snapshot → the same report shape."""
+    """Drain path: aggregate snapshot → the same report shape.
+
+    Verbosity parity with the database walk
+    (/root/reference/cmd/storage-statistics/storage-statistics.go:28-99):
+    -v 1 per-expDate counts; -v 2 additionally lists the serials that
+    exist host-side — the exact host-lane serials carried in the
+    snapshot, plus the PEM-tree filenames when ``certPath`` was set
+    (the tree is keyed ``<exp>/<issuer>/<serialID>``,
+    /root/reference/storage/localdiskbackend.go:194-199); -v 3 dumps
+    those PEMs. Device-lane serials live in the dedup table as
+    128-bit fingerprints + packed (issuer, hour) meta — count-exact
+    but not serial-listable BY DESIGN (SURVEY §7 layer 2c); without a
+    certPath tree they are reported as counts only.
+    """
     import os
 
     from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.core.types import ExpDate, Serial
 
     path = config.agg_state_path
     if not path or not os.path.exists(path):
@@ -37,10 +51,44 @@ def report_from_tpu_snapshot(config: CTConfig, out, verbosity: int = 0) -> int:
     agg.load_checkpoint(path)
     snap = agg.drain()
 
+    backend = None
+    if config.cert_path:
+        from ct_mapreduce_tpu.storage.localdisk import LocalDiskBackend
+
+        backend = LocalDiskBackend(config.cert_path)
+
     # Regroup (issuer, expdate) → issuer.
     by_issuer: dict[str, dict[str, int]] = {}
     for (iss, exp), count in snap.counts.items():
         by_issuer.setdefault(iss, {})[exp] = count
+
+    # Host-lane serial lists by (issuerID, expDateID): the exact-lane
+    # component of each count, listable without any backend. Only built
+    # when the verbosity will print it — the default report must not
+    # pay an O(n log n) sort over millions of host-lane serials.
+    host_lists: dict[tuple[str, str], list] = {}
+    if verbosity >= 2:
+        for (idx, eh), serials in agg.host_serials.items():
+            if not serials:
+                continue
+            key = (agg.registry.issuer_at(idx).id(),
+                   ExpDate.from_unix_hour(eh).id())
+            host_lists[key] = sorted((Serial(s) for s in serials),
+                                     key=lambda s: s.id())
+
+    def listable_serials(iss: str, exp: str):
+        """Serial objects visible host-side for one (issuer, expDate):
+        host-lane snapshot serials + PEM-tree entries (deduped)."""
+        merged = {s.id(): s for s in host_lists.get((iss, exp), [])}
+        if backend is not None:
+            idx = agg.registry.index_of_issuer_id(iss)
+            if idx is not None:
+                exp_date = ExpDate.parse(exp)
+                for s in backend.list_serials_for_expiration_date_and_issuer(
+                    exp_date, agg.registry.issuer_at(idx)
+                ):
+                    merged.setdefault(s.id(), s)
+        return [merged[k] for k in sorted(merged)]
 
     total_serials = 0
     total_crls = 0
@@ -52,9 +100,39 @@ def report_from_tpu_snapshot(config: CTConfig, out, verbosity: int = 0) -> int:
         issuer_serials = sum(dates.values())
         total_serials += issuer_serials
         print(f"Issuer: {iss} ({dns})", file=out)
-        if verbosity >= 1:
-            for exp in sorted(dates):
+        idx = agg.registry.index_of_issuer_id(iss) if verbosity >= 2 else None
+        for exp in sorted(dates):
+            if verbosity >= 1:
                 print(f"- {exp} ({dates[exp]} serials)", file=out)
+            if verbosity >= 2:
+                serial_objs = listable_serials(iss, exp)
+                print(f"  Serials: {[s.id() for s in serial_objs]}", file=out)
+                if len(serial_objs) < dates[exp]:
+                    print(
+                        f"  ({dates[exp] - len(serial_objs)} device-lane "
+                        "serials are count-only; set certPath during "
+                        "ct-fetch to retain listable PEMs)",
+                        file=out,
+                    )
+                if verbosity >= 3:
+                    exp_date = ExpDate.parse(exp)
+                    for serial in serial_objs:
+                        print(
+                            f"Certificate serial={{{serial.hex_string()}}} / "
+                            f"{{{serial.id()}}}",
+                            file=out,
+                        )
+                        if backend is None or idx is None:
+                            continue
+                        try:
+                            pem = backend.load_certificate_pem(
+                                serial, exp_date,
+                                agg.registry.issuer_at(idx),
+                            )
+                            out.write(pem if isinstance(pem, str)
+                                      else pem.decode())
+                        except Exception as err:
+                            print(f"error: {err}", file=out)
         print(
             f" --> {len(dates)} hours, {issuer_serials} serials known, "
             f"{len(crls)} crls known, {len(dns)} issuerDNs known",

@@ -8,7 +8,7 @@ an in-memory sink with a periodic stderr dumper.
 The TPU build generalizes the cache choice: `redisHost` selects the
 Redis-parity fabric; otherwise an in-process MockRemoteCache serves
 single-process runs (the on-device aggregate path needs no external
-cache at all — see storage/tpubackend.py).
+cache at all — see agg/aggregator.py).
 """
 
 from __future__ import annotations

@@ -30,6 +30,7 @@ import queue
 import random
 import threading
 import time
+from collections import deque
 
 import numpy as np
 from dataclasses import dataclass, field
@@ -127,7 +128,8 @@ class AggregatorSink:
     PAD_LEN = 2048  # device row width for the raw path (bucket; certs
     # above it take the exact host lane, like oversized serials)
 
-    def __init__(self, aggregator, flush_size: int = 4096, backend=None):
+    def __init__(self, aggregator, flush_size: int = 4096, backend=None,
+                 device_queue_depth: int = 2):
         self.aggregator = aggregator
         self.flush_size = flush_size
         # Optional durable backend (certPath): first-seen certs get the
@@ -139,6 +141,15 @@ class AggregatorSink:
         self._pending_raw: list[tuple[str, str]] = []
         self._lock = threading.Lock()
         self._dispatch_lock = threading.Lock()  # one device stream
+        # Host↔device pipelining (deviceQueueDepth, SURVEY §2.2 PP row;
+        # the reference overlaps download and store with goroutines + a
+        # 16,384-slot channel, ct-fetch.go:132,398-488): device steps
+        # are SUBMITTED without readback and consumed once more than
+        # `device_queue_depth` batches are in flight, so decode of
+        # batch N+1 overlaps the device step of batch N. Depth 0 =
+        # fully synchronous (reference-exact store ordering).
+        self.device_queue_depth = max(0, int(device_queue_depth))
+        self._inflight: deque = deque()  # (PendingIngest, der_of)
         self.entries_in = 0
 
     def store(self, entry: DecodedEntry, log_url: str) -> None:
@@ -222,19 +233,29 @@ class AggregatorSink:
 
         with self._dispatch_lock, metrics.measure("ct-fetch", "storeCertificate"):
             if valid.any():
-                res = self.aggregator.ingest_packed(
+                pending = self.aggregator.ingest_packed_submit(
                     dec.data, dec.length, issuer_idx, valid
                 )
-                self._store_pems(
-                    res, lambda pos: dec.data[pos, : dec.length[pos]].tobytes()
-                )
+                self._inflight.append((
+                    pending,
+                    lambda pos, _d=dec: _d.data[pos, : _d.length[pos]].tobytes(),
+                ))
             if oversized:
                 res_over = self.aggregator.ingest(oversized)
                 self._store_pems(res_over, lambda pos: oversized[pos][0])
+            self._drain_inflight(self.device_queue_depth)
         metrics.incr_counter(
             "ct-fetch", "insertCertificate",
             value=float(int(valid.sum()) + len(oversized)),
         )
+
+    def _drain_inflight(self, keep: int) -> None:
+        """Complete submitted device work until at most ``keep`` batches
+        remain in flight. Caller holds ``_dispatch_lock``."""
+        while len(self._inflight) > keep:
+            pending, der_of = self._inflight.popleft()
+            res = pending.complete()
+            self._store_pems(res, der_of)
 
     def flush(self) -> None:
         with self._lock:
@@ -244,6 +265,8 @@ class AggregatorSink:
             self._dispatch(batch)
         if raw:
             self._dispatch_raw(raw)
+        with self._dispatch_lock:
+            self._drain_inflight(0)
 
     def checkpointed_save(self, save_fn) -> None:
         """Flush pending entries, then run ``save_fn`` while holding the

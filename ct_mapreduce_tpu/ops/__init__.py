@@ -1,0 +1,18 @@
+"""Device ops: the jitted/Pallas kernels of the ingest pipeline.
+
+- :mod:`~ct_mapreduce_tpu.ops.der_kernel` — batched DER/TLV field
+  extraction (the reference's per-cert x509 parse, done data-parallel).
+- :mod:`~ct_mapreduce_tpu.ops.sha256` — jitted SHA-256 over packed
+  blocks; :mod:`~ct_mapreduce_tpu.ops.pallas_sha256` — the Pallas
+  variant.
+- :mod:`~ct_mapreduce_tpu.ops.hashtable` — HBM-resident dedup set
+  (insert-if-absent, the Redis SADD replacement).
+- :mod:`~ct_mapreduce_tpu.ops.pipeline` — the fused ingest step.
+"""
+
+from ct_mapreduce_tpu.ops import (  # noqa: F401
+    der_kernel,
+    hashtable,
+    pipeline,
+    sha256,
+)

@@ -159,10 +159,19 @@ def local_lanes(
     passed = ok & ~f_ca & ~f_expired & ~f_cn
 
     # Device-exactness gate: lanes outside the packed schema go host-side.
+    # A cert expiring WITHIN the current hour is also routed to the
+    # exact host lane: the device compares hour buckets, the reference
+    # compares instants (`NotAfter.Before(now)`,
+    # /root/reference/cmd/ct-fetch/ct-fetch.go:52-55); buckets strictly
+    # before/after `now_hour` classify identically either way, and the
+    # boundary bucket gets the exact instant compare on host
+    # (TpuAggregator._host_exact), so the combined system matches the
+    # reference exactly.
     hour_off = parsed.not_after_hour - base_hour
     meta_ok = (hour_off >= 0) & (hour_off < packing.META_HOUR_SPAN)
     idx_ok = (issuer_idx >= 0) & (issuer_idx < num_issuers)
-    device_exact = fits & meta_ok & idx_ok
+    boundary_hour = parsed.not_after_hour == now_hour
+    device_exact = fits & meta_ok & idx_ok & ~boundary_hour
 
     fps = fingerprints(issuer_idx, parsed.not_after_hour, serials, parsed.serial_len)
     meta = (

@@ -10,7 +10,7 @@ LRU); GetIssuerAndDatesFromCache enumerates `serials::*` keys
 
 This host path is the behavioral baseline the TPU pipeline is checked
 against ("issuer-count parity"); the batched device path lives in
-ct_mapreduce_tpu.storage.tpubackend.
+ct_mapreduce_tpu.agg.aggregator.
 """
 
 from __future__ import annotations

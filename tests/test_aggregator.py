@@ -109,6 +109,72 @@ def test_filters_counted():
     assert a.metrics["filtered_ca"] == 1
 
 
+def test_expiring_this_hour_exact_boundary():
+    """Expiry-filter granularity at the bucket boundary: the device
+    compares hour buckets while the reference compares instants
+    (/root/reference/cmd/ct-fetch/ct-fetch.go:52-55 via
+    `NotAfter.Before(now)`). Certs expiring WITHIN the current hour are
+    routed to the exact host lane (ops/pipeline.py device_exact gate),
+    so the combined system matches the reference instant-exactly:
+    NotAfter just before `now` filters, just after `now` survives."""
+    now = datetime.datetime(2024, 6, 1, 14, 45, tzinfo=UTC)
+    a = agg(now=now)
+    ca = make_cert(issuer_cn="Edge CA")
+    prev_hour = leaf(  # NotAfter 13:50 — earlier bucket, device-filtered
+        7100, issuer_cn="Edge CA",
+        not_before=datetime.datetime(2020, 1, 1, tzinfo=UTC),
+        not_after=datetime.datetime(2024, 6, 1, 13, 50, tzinfo=UTC),
+    )
+    just_gone = leaf(  # NotAfter 14:30 < now — boundary bucket, expired
+        7101, issuer_cn="Edge CA",
+        not_before=datetime.datetime(2020, 1, 1, tzinfo=UTC),
+        not_after=datetime.datetime(2024, 6, 1, 14, 30, tzinfo=UTC),
+    )
+    still_ok = leaf(  # NotAfter 14:55 > now — boundary bucket, valid
+        7102, issuer_cn="Edge CA",
+        not_before=datetime.datetime(2020, 1, 1, tzinfo=UTC),
+        not_after=datetime.datetime(2024, 6, 1, 14, 55, tzinfo=UTC),
+    )
+    next_hour = leaf(  # NotAfter 15:05 — later bucket, device-kept
+        7103, issuer_cn="Edge CA",
+        not_before=datetime.datetime(2020, 1, 1, tzinfo=UTC),
+        not_after=datetime.datetime(2024, 6, 1, 15, 5, tzinfo=UTC),
+    )
+    res = a.ingest([(prev_hour, ca), (just_gone, ca),
+                    (still_ok, ca), (next_hour, ca)])
+    assert list(res.filtered) == [True, True, False, False]
+    assert list(res.was_unknown) == [False, False, True, True]
+    assert a.metrics["filtered_expired"] == 2
+    # Both boundary-bucket lanes took the exact host lane.
+    assert res.host_lane_count == 2
+    assert a.drain().total == 2
+
+
+def test_boundary_migration_no_double_count():
+    """A cert deduped on DEVICE whose later duplicate arrives during its
+    expiry hour migrates to the host lane (boundary routing). The host
+    lane's cross-domain guard must consult the device table so the
+    serial counts once — the reference's single Redis SADD set can
+    never double count (/root/reference/storage/knowncertificates.go:38-55)."""
+    ca = make_cert(issuer_cn="Mig CA")
+    x = leaf(
+        7300, issuer_cn="Mig CA",
+        not_before=datetime.datetime(2020, 1, 1, tzinfo=UTC),
+        not_after=datetime.datetime(2024, 6, 1, 14, 30, tzinfo=UTC),
+    )
+    a = agg(now=datetime.datetime(2024, 6, 1, 13, 10, tzinfo=UTC))
+    r1 = a.ingest([(x, ca)])
+    assert r1.was_unknown[0] and r1.host_lane_count == 0
+    # Same cert again, now inside its expiry hour: boundary → host lane.
+    a._fixed_now = datetime.datetime(2024, 6, 1, 14, 10, tzinfo=UTC)
+    r2 = a.ingest([(x, ca)])
+    assert r2.host_lane_count == 1
+    assert not r2.was_unknown[0]  # known via the device table, not re-counted
+    assert not r2.filtered[0]  # 14:30 > 14:10 — still valid
+    assert a.drain().total == 1
+    assert a.metrics["inserted"] == 1 and a.metrics["known"] == 1
+
+
 def test_host_lane_garbage_and_oversize():
     a = agg()
     ca = make_cert(issuer_cn="Host CA")

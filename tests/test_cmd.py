@@ -114,6 +114,60 @@ def test_ct_fetch_tpu_backend_with_certpath_writes_pems(tmp_path, monkeypatch):
     assert list(certs.rglob(".dirty")) or list(certs.rglob("*dirty*"))
 
 
+def test_storage_statistics_tpu_v2_v3(tmp_path, monkeypatch, capsys):
+    """--backend=tpu verbosity parity (storage-statistics.go:28-99):
+    -v2 lists serials (PEM-tree + host-lane), -v3 dumps the PEMs. With
+    certPath set during the fetch, every first-seen cert is listable."""
+    log = _fake_log(n=5, dupes=1)
+    _patch_transport(monkeypatch, log)
+    certs = tmp_path / "certs"
+    ini = tmp_path / "ct.ini"
+    ini.write_text(
+        f"logList = {log.url}\n"
+        "backend = tpu\n"
+        "batchSize = 64\n"
+        "tableBits = 12\n"
+        f"certPath = {certs}\n"
+        f"aggStatePath = {tmp_path / 'agg.npz'}\n"
+        "healthAddr = \n"
+    )
+    assert ct_fetch.main(["-config", str(ini), "-nobars"]) == 0
+
+    rc = storage_statistics.main(["-config", str(ini), "-v", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Serials: [" in out
+    # 4 distinct serials (5 entries, 1 dupe), all listable via the tree.
+    import re
+
+    listed = re.findall(r"Serials: \[([^\]]*)\]", out)
+    n_listed = sum(len([x for x in blob.split(",") if x.strip()])
+                   for blob in listed)
+    assert n_listed == 4
+    assert "count-only" not in out  # nothing unlisted when certPath set
+
+    rc = storage_statistics.main(["-config", str(ini), "-v", "3"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("-----BEGIN CERTIFICATE-----") == 4
+    assert "Certificate serial={" in out
+
+    # Without the PEM tree, device-lane serials are count-only and say so.
+    ini2 = tmp_path / "ct2.ini"
+    ini2.write_text(
+        f"logList = {log.url}\n"
+        "backend = tpu\n"
+        "batchSize = 64\n"
+        "tableBits = 12\n"
+        f"aggStatePath = {tmp_path / 'agg.npz'}\n"
+        "healthAddr = \n"
+    )
+    rc = storage_statistics.main(["-config", str(ini2), "-v", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "count-only" in out
+
+
 def test_ct_fetch_requires_loglist(capsys):
     rc = ct_fetch.main(["-nobars"])
     assert rc == 2

@@ -326,6 +326,76 @@ def test_raw_batch_oversized_cert_host_lane():
     assert agg.drain().total == 2
 
 
+def test_device_queue_depth_pipelines_submissions():
+    """SURVEY §2.2 PP row: at deviceQueueDepth >= 2 the sink SUBMITS
+    batch N+1 before COMPLETING batch N — decode overlaps the device
+    step, like the reference's downloader/worker channel overlap
+    (ct-fetch.go:132,398-488). At depth 0 every dispatch completes
+    synchronously. Both depths produce identical aggregate state."""
+    import base64
+
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.ingest.sync import RawBatch
+
+    issuer_der = certgen.make_cert(serial=1, issuer_cn="Pipe CA",
+                                   is_ca=True, not_after=FUTURE)
+
+    def raw_batch(serials):
+        lis, eds = [], []
+        for s in serials:
+            der = certgen.make_cert(
+                serial=s, issuer_cn="Pipe CA", subject_cn="p.example.com",
+                is_ca=False, not_after=FUTURE,
+            )
+            lis.append(base64.b64encode(
+                leaflib.encode_leaf_input(der, 1)).decode())
+            eds.append(base64.b64encode(
+                leaflib.encode_extra_data([issuer_der])).decode())
+        return RawBatch(lis, eds, 0, "log")
+
+    def run(depth):
+        agg = TpuAggregator(capacity=1 << 12, batch_size=16,
+                            now=datetime.datetime(2025, 1, 1, tzinfo=UTC))
+        events = []
+        submit_orig = agg.ingest_packed_submit
+
+        def submit(*a, **k):
+            p = submit_orig(*a, **k)
+            events.append(("submit", id(p)))
+            orig_complete = p.complete
+
+            def complete():
+                if not p._done:
+                    events.append(("complete", id(p)))
+                return orig_complete()
+
+            p.complete = complete
+            return p
+
+        agg.ingest_packed_submit = submit
+        sink = AggregatorSink(agg, flush_size=16, device_queue_depth=depth)
+        for i in range(4):
+            base = 1000 + 16 * i
+            sink.store_raw_batch(raw_batch(range(base, base + 16)))
+        sink.flush()
+        snap = agg.drain()
+        return events, snap
+
+    ev0, snap0 = run(0)
+    ev2, snap2 = run(2)
+    assert snap0.counts == snap2.counts
+    assert snap0.total == snap2.total == 64
+    kinds0 = [k for k, _ in ev0]
+    assert kinds0 == ["submit", "complete"] * 4  # depth 0: fully serial
+    kinds2 = [k for k, _ in ev2]
+    # depth 2: three submissions are in flight before the first readback.
+    assert kinds2.index("complete") == 3
+    # FIFO: completion order equals submission order.
+    sub_ids = [i for k, i in ev2 if k == "submit"]
+    com_ids = [i for k, i in ev2 if k == "complete"]
+    assert com_ids == sub_ids
+
+
 # -- health -----------------------------------------------------------------
 
 
