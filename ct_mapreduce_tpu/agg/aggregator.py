@@ -210,6 +210,13 @@ class TpuAggregator:
         self.issuer_totals = np.zeros((packing.MAX_ISSUERS,), np.int64)
         # Submitted-but-not-completed pipelined ingests (FIFO).
         self._outstanding: list[PendingIngest] = []
+        # False until the first device-step submit: lets the host lane
+        # skip cross-domain membership probes entirely for host-only
+        # usage (each probe is a device dispatch + synchronous read).
+        self._device_written = False
+        # Set False by a sink that never materializes PEMs: skips the
+        # per-entry serial-bytes construction in `_consume_out`.
+        self.want_serials = True
         self.set_cn_prefixes(cn_prefixes)
         self.metrics: dict[str, int] = {
             "inserted": 0, "known": 0, "filtered_ca": 0, "filtered_expired": 0,
@@ -397,28 +404,40 @@ class TpuAggregator:
             self.metrics["dispatch_spill"] += int(np.asarray(dropped).sum())
         self.issuer_totals += np.asarray(out.issuer_unknown_counts, np.int64)
 
-        host_pos = []
-        for i, pos in enumerate(device_pos):
-            lane = lane_of(pos) if lane_of is not None else i
-            if hl[lane]:
-                host_pos.append(pos)
-                continue
-            res.filtered[pos] = f_any[lane]
-            if not f_any[lane]:
-                res.exp_hours[pos] = nah[lane]
-                res.serials[pos] = sarr[lane, : slen[lane]].tobytes()
-                if wu[lane]:
+        # Vectorized fold-in (the per-entry Python loop here was the e2e
+        # ingest bottleneck): positions and lanes as index arrays, with
+        # per-entry Python only where bytes objects are genuinely needed
+        # (serial materialization for PEM trees / the cross-encoding
+        # guard — skipped entirely for count-only sinks).
+        n = len(device_pos)
+        pos_arr = np.asarray(device_pos, dtype=np.int64).reshape(n)
+        if lane_of is None:
+            lanes = np.arange(n, dtype=np.int64)
+        else:
+            lanes = np.array([lane_of(p) for p in device_pos], dtype=np.int64)
+        hl_l = hl[lanes]
+        host_pos = [int(p) for p in pos_arr[hl_l]]
+        okm = ~hl_l
+        f_l = f_any[lanes]
+        res.filtered[pos_arr[okm]] = f_l[okm]
+        keep = okm & ~f_l
+        kp, kl = pos_arr[keep], lanes[keep]
+        res.exp_hours[kp] = nah[kl]
+        if self.want_serials or self.host_serials:
+            for p_, l_ in zip(kp, kl):
+                sb = sarr[l_, : slen[l_]].tobytes()
+                res.serials[p_] = sb
+                if wu[l_]:
                     # Cross-encoding guard (see module docstring).
-                    key = (int(batch.issuer_idx[lane]), int(nah[lane]))
-                    if res.serials[pos] in self.host_serials.get(key, ()):
-                        wu[lane] = False
+                    key = (int(batch.issuer_idx[l_]), int(nah[l_]))
+                    if sb in self.host_serials.get(key, ()):
+                        wu[l_] = False
                     else:
-                        res.was_unknown[pos] = True
+                        res.was_unknown[p_] = True
+        else:
+            res.was_unknown[kp[wu[kl]]] = True
         self._accumulate_metadata_lanes(
-            batch, out,
-            [(lane_of(pos) if lane_of is not None else i, pos)
-             for i, pos in enumerate(device_pos)],
-            res.was_unknown,
+            batch, out, lanes, pos_arr, res.was_unknown
         )
         dev_unknown = int(wu.sum())
         dev_known = len(device_pos) - int(hl.sum()) - dev_unknown
@@ -455,6 +474,7 @@ class TpuAggregator:
         return len(host_pos)
 
     def _device_step_packed(self, batch):
+        self._device_written = True
         self.table, out = pipeline.ingest_step(
             self.table,
             batch.data,
@@ -469,24 +489,46 @@ class TpuAggregator:
         )
         return out
 
-    def _accumulate_metadata_lanes(self, batch, out, lane_pos, was_unknown_global):
+    def _accumulate_metadata_lanes(self, batch, out, lanes, pos_arr,
+                                   was_unknown_global):
         """CRL/DN accumulation for device-unknown lanes, keyed by raw
         byte windows so each distinct encoding is parsed once.
-        ``lane_pos``: (chunk lane, global position) pairs."""
-        wu_lanes = [
-            lane for lane, pos in lane_pos if was_unknown_global[pos]
-        ]
-        if not wu_lanes:
+        ``lanes``/``pos_arr``: chunk-lane and global-position index
+        arrays. Work is reduced to UNIQUE byte windows first (np.unique
+        over the extracted windows, C-speed) so per-chunk Python cost
+        is O(#distinct issuers/CRL encodings), not O(batch)."""
+        wu = was_unknown_global[pos_arr]
+        wu_lanes = np.asarray(lanes)[wu]
+        if wu_lanes.size == 0:
             return
         dp_off = np.asarray(out.crldp_off)
         dp_len = np.asarray(out.crldp_len)
         in_off = np.asarray(out.issuer_name_off)
         in_len = np.asarray(out.issuer_name_len)
-        for lane in wu_lanes:
-            idx = int(batch.issuer_idx[lane])
-            row = batch.data[lane]
-            # issuer DN
-            raw_name = row[in_off[lane] : in_off[lane] + in_len[lane]].tobytes()
+        data = np.asarray(batch.data)
+        issuer_idx = np.asarray(batch.issuer_idx)
+
+        def rep_windows(offs, lens):
+            """Representative lane per unique (issuer, window bytes)."""
+            o, ln = offs[wu_lanes], lens[wu_lanes]
+            width = int(ln.max(initial=0))
+            if width == 0:
+                return np.zeros((0,), np.int64)
+            cols = o[:, None] + np.arange(width, dtype=o.dtype)[None, :]
+            cols = np.clip(cols, 0, data.shape[1] - 1)
+            wins = data[wu_lanes[:, None], cols]
+            wins[np.arange(width)[None, :] >= ln[:, None]] = 0
+            tagged = np.concatenate(
+                [issuer_idx[wu_lanes, None].astype(np.int64),
+                 ln[:, None].astype(np.int64),
+                 wins.astype(np.int64)], axis=1,
+            )
+            _, first = np.unique(tagged, axis=0, return_index=True)
+            return wu_lanes[first]
+
+        for lane in rep_windows(in_off, in_len):
+            idx = int(issuer_idx[lane])
+            raw_name = data[lane, in_off[lane] : in_off[lane] + in_len[lane]].tobytes()
             if (idx, raw_name) not in self._dn_raw_seen:
                 self._dn_raw_seen.add((idx, raw_name))
                 try:
@@ -495,16 +537,18 @@ class TpuAggregator:
                     self.dn_sets.setdefault(idx, set()).add(dn)
                 except Exception:
                     pass
-            # CRL DPs
-            if dp_len[lane] > 0:
-                raw_dp = row[dp_off[lane] : dp_off[lane] + dp_len[lane]].tobytes()
-                if (idx, raw_dp) not in self._crl_raw_seen:
-                    self._crl_raw_seen.add((idx, raw_dp))
-                    try:
-                        urls = hostder._parse_crldp(raw_dp, 0)
-                    except Exception:
-                        urls = []
-                    self._add_crls(idx, urls)
+        for lane in rep_windows(dp_off, dp_len):
+            if dp_len[lane] <= 0:
+                continue
+            idx = int(issuer_idx[lane])
+            raw_dp = data[lane, dp_off[lane] : dp_off[lane] + dp_len[lane]].tobytes()
+            if (idx, raw_dp) not in self._crl_raw_seen:
+                self._crl_raw_seen.add((idx, raw_dp))
+                try:
+                    urls = hostder._parse_crldp(raw_dp, 0)
+                except Exception:
+                    urls = []
+                self._add_crls(idx, urls)
 
     def _add_crls(self, issuer_idx: int, urls: list[str]) -> None:
         """http/https only; ldap silently dropped
@@ -556,6 +600,8 @@ class TpuAggregator:
         items: [(issuer_idx, exp_hour, serial_bytes)] → bool per item.
         """
         flags = [False] * len(items)
+        if not self._device_written:
+            return flags
         cand, fps = [], []
         for j, (issuer_idx, eh, serial) in enumerate(items):
             if (
@@ -706,6 +752,7 @@ class TpuAggregator:
             meta=jnp.asarray(z["meta"]),
             count=jnp.asarray(z["count"]),
         )
+        self._device_written = bool(np.asarray(z["count"]).sum() > 0)
         self.capacity = int(z["keys"].shape[0])
         self.base_hour = int(z["base_hour"])
         self.registry = IssuerRegistry.from_json(z["registry"].tobytes().decode())

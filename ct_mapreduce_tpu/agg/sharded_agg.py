@@ -65,6 +65,7 @@ class ShardedAggregator(TpuAggregator):
         return self.dedup.contains_np(fps)
 
     def _device_step_packed(self, batch):
+        self._device_written = True
         return self.dedup.step(
             np.asarray(batch.data),
             np.asarray(batch.length),
@@ -111,6 +112,7 @@ class ShardedAggregator(TpuAggregator):
             dispatch_factor=self.dedup.dispatch_factor,
         )
         overflow = self.dedup.bulk_insert_np(keys_np[occ], meta_np[occ])
+        self._device_written = bool(occ.any()) or self._device_written
         if overflow:
             raise RuntimeError(
                 f"checkpoint restore overflowed {overflow} rows; "

@@ -15,31 +15,43 @@ Keys are 128-bit truncated SHA-256 fingerprints of
 fingerprints are remapped away from it (probability 2^-128 anyway).
 
 Insertion algorithm (bounded trip count, jit/pjit-friendly — the probe
-loop is a ``lax.while_loop`` that exits as soon as no lane is pending,
-probing at most ``max_probes`` rounds):
+loop is a ``lax.while_loop`` that exits as soon as no lane is pending;
+sort-free, gather-light):
 
-1. *Within-batch dedup*: lexsort lanes by the 4 key words; a lane is a
-   "representative" iff its key differs from its sorted predecessor.
-   Duplicate lanes inside one batch report ``was_unknown=False`` for
-   every occurrence after the first, matching Redis semantics when the
-   reference stores the same serial twice in a row.
-2. *Probe rounds* (triangular probing over a power-of-two capacity,
-   guaranteed full-cycle): each pending representative gathers its
-   slot; a 4-word compare detects "already present"; empty slots are
-   claimed by a deterministic scatter-min election: contenders
-   scatter their lane id into a claim scratch with ``.min`` (min is
-   commutative — duplicate indices are safe and order-independent),
-   read the slot back, and the lane whose id survived is the winner.
-   Winners therefore hold **unique** slots, so the key/meta scatters
-   never see duplicate indices (XLA's duplicate-index scatter is
-   specified per element, NOT per row — a whole-row CAS via
-   duplicate scatter could tear). This replaces the previous
-   per-round sort-based election — 32 extra full-batch lexsorts per
-   insert call — with three cheap scatters and two gathers per round.
-3. Lanes that exhaust ``max_probes`` are reported in ``overflowed``;
-   the aggregator sends them down the exact host lane (the same
-   reject-to-host contract the reference uses for unparseable entries,
-   /root/reference/cmd/ct-fetch/ct-fetch.go:206-225).
+Each lane carries its own probe index ``r`` (triangular probing over a
+power-of-two capacity, guaranteed full-cycle). Per round, every
+pending lane examines a WINDOW of ``PROBE_WIDTH`` consecutive chain
+positions in one gather, and resolves at the first position that is
+not an occupied mismatch:
+
+- 4-word compare says "already present" → done, ``was_unknown=False``;
+- first empty slot in the window → contend via a deterministic
+  scatter-min election: contenders scatter their lane id into a claim
+  scratch with ``.min`` (min is commutative — duplicate indices are
+  safe and order-independent) and read it back; the surviving lane
+  wins and writes key+meta (winners hold unique slots, so those
+  scatters never see duplicate indices — XLA's duplicate-index
+  scatter is specified per element, not per row, so a whole-row CAS
+  could tear). Losers advance ``r`` TO the contested position and
+  re-examine it next round — now occupied, it resolves as a match (a
+  within-batch duplicate: first-in-lane-order wins, exactly Redis
+  SADD semantics when the reference stores the same serial twice) or
+  a mismatch (probe on);
+- all window positions occupied by other keys → ``r`` advances past
+  the window.
+
+A key always lands at the FIRST empty slot of its probe chain (losers
+never skip the contested slot), so ``contains``' probe-until-empty
+lookup invariant holds.
+
+Within-batch dedup therefore falls out of the probe loop itself — no
+pre-pass needed (the previous design ran 33 full-batch lexsorts per
+insert; this one runs zero sorts).
+
+Lanes that exhaust ``max_probes`` (or the round budget) are reported
+in ``overflowed``; the aggregator sends them down the exact host lane
+(the same reject-to-host contract the reference uses for unparseable
+entries, /root/reference/cmd/ct-fetch/ct-fetch.go:206-225).
 
 Alongside each key a ``meta`` word (packed issuer index + expiry hour
 offset, :mod:`ct_mapreduce_tpu.core.packing`) is stored so a drain can
@@ -55,6 +67,9 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+PROBE_WIDTH = 4  # chain positions examined per probe round (one gather)
 
 
 class TableState(NamedTuple):
@@ -113,49 +128,41 @@ def insert(
     capacity = state.keys.shape[0]
     b = keys.shape[0]
     keys = _desentinel(keys.astype(jnp.uint32))
-
-    # --- 1. within-batch first-occurrence detection ---------------------
-    # lexsort: last key is primary. Invalid lanes sort with key 0 but are
-    # masked out of representative status below.
-    order = jnp.lexsort((keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0]))
-    sk = keys[order]
-    same_as_prev = jnp.concatenate(
-        [jnp.zeros((1,), bool), jnp.all(sk[1:] == sk[:-1], axis=-1)]
-    )
-    sorted_valid = valid[order]
-    # First *valid* lane of each equal-key run is the representative.
-    # (Invalid lanes never represent; a run of [invalid, valid] with equal
-    # keys must still elect the valid one, so walk with a scan max.)
-    run_id = jnp.cumsum(~same_as_prev)  # 1-based run index per sorted lane
-    # representative = first valid lane in its run
-    first_valid_pos = jnp.full((b + 1,), b, dtype=jnp.int32)
-    pos = jnp.arange(b, dtype=jnp.int32)
-    first_valid_pos = first_valid_pos.at[run_id].min(
-        jnp.where(sorted_valid, pos, b)
-    )
-    sorted_rep = sorted_valid & (pos == first_valid_pos[run_id])
-    rep = jnp.zeros((b,), bool).at[order].set(sorted_rep)
-
-    # --- 2. probe rounds ------------------------------------------------
     home = _home_slot(keys, capacity)
 
     lane = jnp.arange(b, dtype=jnp.int32)
     no_lane = jnp.int32(2**31 - 1)
+    W = min(PROBE_WIDTH, max_probes)
+    # A lane can lose one election per slot before the slot resolves,
+    # so the round budget is 2×max_probes (+1 slack); lanes that leave
+    # the loop still pending are overflow → exact host lane.
+    max_rounds = 2 * max_probes + 1
 
     def cond(carry):
-        r, _tk, _tm, _claim, pending, _found, _inserted = carry
-        return (r < max_probes) & jnp.any(pending)
+        rounds, _r, _tk, _tm, _claim, pending, _found, _inserted, _ovf = carry
+        return (rounds < max_rounds) & jnp.any(pending)
 
     def round_body(carry):
-        r, table_keys, table_meta, claim, pending, found, inserted = carry
-        # triangular probing: offset r(r+1)/2 cycles a power-of-two table
-        slot = (home + (r * (r + 1)) // 2) & (capacity - 1)
-        cur = table_keys[slot]  # [B, 4]
-        match = jnp.all(cur == keys, axis=-1) & pending
-        empty = jnp.all(cur == 0, axis=-1) & pending
-        # Deterministic election: scatter-min lane ids at contested
-        # empty slots (min commutes ⇒ duplicate indices are safe),
-        # read back; the surviving lane id is the winner.
+        (rounds, r, table_keys, table_meta, claim,
+         pending, found, inserted, ovf) = carry
+        # Probe window: W consecutive triangular-chain positions
+        # starting at each lane's r, fetched in ONE gather.
+        rj = r[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]  # [B, W]
+        slots = (home[:, None] + (rj * (rj + 1)) // 2) & (capacity - 1)
+        in_budget = rj < max_probes
+        cur = table_keys[slots]  # [B, W, 4]
+        match_j = jnp.all(cur == keys[:, None, :], axis=-1) & in_budget
+        empty_j = jnp.all(cur == 0, axis=-1) & in_budget
+        stop_j = match_j | empty_j
+        any_stop = jnp.any(stop_j, axis=-1)
+        jstar = jnp.argmax(stop_j, axis=-1).astype(jnp.int32)  # first stop
+        sel = jnp.take_along_axis  # alias
+        match = pending & any_stop & sel(match_j, jstar[:, None], 1)[:, 0]
+        empty = pending & any_stop & ~match
+        slot = sel(slots, jstar[:, None], 1)[:, 0]
+        # Deterministic election at each lane's first-empty slot:
+        # scatter-min lane ids (min commutes ⇒ duplicate indices are
+        # safe), read back; the surviving lane id is the winner.
         cslot = jnp.where(empty, slot, capacity)  # OOB rows are dropped
         claim = claim.at[cslot].min(lane, mode="drop")
         winner = empty & (claim[slot] == lane)
@@ -168,10 +175,20 @@ def insert(
         found = found | match
         inserted = inserted | winner
         pending = pending & ~match & ~winner
-        return r + 1, table_keys, table_meta, claim, pending, found, inserted
+        # Election losers advance r TO the contested position (they
+        # re-examine it next round); miss-through lanes skip the window.
+        r = jnp.where(pending, jnp.where(any_stop, r + jstar, r + W), r)
+        # A lane that exhausts its probe chain is overflow — record it
+        # and drop it from pending so the loop can terminate early.
+        exhausted = pending & (r >= max_probes)
+        ovf = ovf | exhausted
+        pending = pending & ~exhausted
+        return (rounds + 1, r, table_keys, table_meta, claim,
+                pending, found, inserted, ovf)
 
-    pending0 = rep
+    pending0 = valid
     zeros = jnp.zeros((b,), bool)
+    r0 = jnp.zeros((b,), jnp.int32)
     # Fresh capacity-sized claim scratch per call: a single ~4B/slot
     # broadcast fill (≈0.3 ms at 2^26 on v5e HBM, against a multi-ms
     # step) buys an election that needs no persistent state — keeping
@@ -179,13 +196,18 @@ def insert(
     # sharded per-shard reconstruction. Revisit only if profiles show
     # the fill on the flame graph.
     claim0 = jnp.full((capacity,), no_lane, dtype=jnp.int32)
-    _, table_keys, table_meta, _, pending, found, inserted = jax.lax.while_loop(
+    (_, _, table_keys, table_meta, _, pending, found,
+     inserted, ovf) = jax.lax.while_loop(
         cond, round_body,
-        (jnp.int32(0), state.keys, state.meta, claim0, pending0, zeros, zeros),
+        (jnp.int32(0), r0, state.keys, state.meta, claim0,
+         pending0, zeros, zeros, zeros),
     )
 
-    was_unknown = inserted  # representatives that claimed a slot
-    overflowed = pending  # representatives that never found a home
+    was_unknown = inserted  # lanes that claimed a slot
+    # Never found a home: probe chain exhausted, or still pending when
+    # the round budget ran out (pathological contention) — either way
+    # the exact host lane takes over.
+    overflowed = ovf | pending
     new_count = state.count + jnp.sum(inserted, dtype=jnp.int32)
     return TableState(table_keys, table_meta, new_count), was_unknown, overflowed
 

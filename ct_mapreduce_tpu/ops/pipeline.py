@@ -87,18 +87,17 @@ def fingerprints(
 
 
 def _cn_prefix_match(
-    data: jax.Array, cn_off: jax.Array, cn_len: jax.Array,
+    rows, cn_off: jax.Array, cn_len: jax.Array,
     prefixes: jax.Array, prefix_lens: jax.Array,
 ) -> jax.Array:
     """Does the issuer CN start with any configured prefix? bool[B].
 
     prefixes: uint8[P, K]; prefix_lens: int32[P]. P == 0 handled by the
-    caller (filter disabled).
+    caller (filter disabled). ``rows`` are the shared word-packed rows
+    (:func:`der_kernel.window_bytes_rows` — gather-free).
     """
-    b, l = data.shape
     k = prefixes.shape[1]
-    idx = cn_off[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
-    window = jnp.take_along_axis(data, jnp.clip(idx, 0, l - 1), axis=1)  # [B, K]
+    window = der_kernel.window_bytes_rows(rows, cn_off, k).astype(jnp.uint8)
     inside = jnp.arange(k, dtype=jnp.int32)[None, :] < cn_len[:, None]
     window = jnp.where(inside, window, 0)
     # [B, P, K] compare, masked beyond each prefix's length
@@ -136,12 +135,17 @@ def local_lanes(
     num_issuers: int,
 ) -> LocalLanes:
     """Parse → filter → fingerprint, shared by the single-chip step and
-    the per-device body of the mesh-sharded step (no communication)."""
-    parsed = der_kernel.parse_certs(data, length)
+    the per-device body of the mesh-sharded step (no communication).
+
+    Rows are word-packed ONCE and shared by the parse walker, the
+    serial extraction, and the CN window — one pass over [B, L], not
+    three (der_kernel's gather-free access path)."""
+    rows = der_kernel.pack_rows(data)
+    parsed = der_kernel.parse_certs_rows(rows, length)
     ok = parsed.ok & valid
 
-    serials, fits = der_kernel.gather_serials(
-        data, parsed.serial_off, parsed.serial_len, packing.MAX_SERIAL_BYTES
+    serials, fits = der_kernel.gather_serials_rows(
+        rows, parsed.serial_off, parsed.serial_len, packing.MAX_SERIAL_BYTES
     )
 
     # Filters, in the reference's precedence order
@@ -150,7 +154,7 @@ def local_lanes(
     f_expired = ok & ~f_ca & (parsed.not_after_hour < now_hour)
     if cn_prefixes.shape[0] > 0:
         cn_hit = _cn_prefix_match(
-            data, parsed.issuer_cn_off, parsed.issuer_cn_len,
+            rows, parsed.issuer_cn_off, parsed.issuer_cn_len,
             cn_prefixes, cn_prefix_lens,
         )
         f_cn = ok & ~f_ca & ~f_expired & ~cn_hit

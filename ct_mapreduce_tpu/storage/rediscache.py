@@ -141,6 +141,9 @@ class RedisCache(RemoteCache):
                 file=sys.stderr,
             )
 
+    def close(self) -> None:
+        self.client.close()
+
     def memory_policy_correct(self) -> bool:
         info = self.client.execute("INFO", "memory") or ""
         for line in str(info).splitlines():
