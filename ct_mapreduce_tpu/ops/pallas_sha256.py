@@ -42,7 +42,43 @@ def _kernel(k_ref, h0_ref, block_ref, out_ref):
     block_ref: uint32[16, TILE]; out_ref: uint32[8, TILE].
 
     (Constants arrive as inputs — Pallas kernels cannot capture array
-    constants from the enclosing trace.)"""
+    constants from the enclosing trace.)
+
+    The 64 rounds are UNROLLED in Python so every schedule access is a
+    static index: Mosaic's TPU lowering has no dynamic_slice, which is
+    what a fori_loop + dynamic_index_in_dim formulation requires (that
+    variant lowers only in interpret mode — it is kept below as
+    ``_kernel_looped`` because interpreting 64 unrolled rounds is
+    orders of magnitude slower than interpreting one fori_loop). The
+    rolling 16-entry schedule lives in a Python list of [TILE] vectors
+    — all VMEM/VREG resident for the whole compression."""
+    tile = block_ref.shape[1]
+    w = [block_ref[i, :] for i in range(16)]
+    a, b, c, d, e, f, g, h = (
+        jnp.broadcast_to(h0_ref[i, :], (tile,)) for i in range(8)
+    )
+    for t in range(64):
+        wt = w[t % 16]
+        kt = k_ref[t, 0]
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kt + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        a, b, c, d, e, f, g, h = t1 + t2, a, b, c, d + t1, e, f, g
+        if t < 48:
+            # Rolling schedule: W[t+16] replaces W[t] in place.
+            w1, w9, w14 = w[(t + 1) % 16], w[(t + 9) % 16], w[(t + 14) % 16]
+            sg0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> np.uint32(3))
+            sg1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> np.uint32(10))
+            w[t % 16] = wt + sg0 + w9 + sg1
+    for i, v in enumerate((a, b, c, d, e, f, g, h)):
+        out_ref[i, :] = v + jnp.broadcast_to(h0_ref[i, :], (tile,))
+
+
+def _kernel_looped(k_ref, h0_ref, block_ref, out_ref):
+    """fori_loop formulation — interpret-mode only (see `_kernel`)."""
 
     def round_body(t, carry):
         state, w = carry
@@ -59,7 +95,6 @@ def _kernel(k_ref, h0_ref, block_ref, out_ref):
         maj = (a & b) ^ (a & c) ^ (b & c)
         t2 = s0 + maj
         state = jnp.stack([t1 + t2, a, b, c, d + t1, e, f, g])
-        # Rolling schedule: W[t+16] replaces W[t] in place.
         w1 = jax.lax.dynamic_index_in_dim(w, (t + 1) % 16, 0, keepdims=False)
         w9 = jax.lax.dynamic_index_in_dim(w, (t + 9) % 16, 0, keepdims=False)
         w14 = jax.lax.dynamic_index_in_dim(w, (t + 14) % 16, 0, keepdims=False)
@@ -68,7 +103,7 @@ def _kernel(k_ref, h0_ref, block_ref, out_ref):
         w = jax.lax.dynamic_update_index_in_dim(w, wt + sg0 + w9 + sg1, i0, 0)
         return state, w
 
-    w = block_ref[:]  # [16, TILE] — VMEM-resident for all 64 rounds
+    w = block_ref[:]  # [16, TILE]
     tile = w.shape[1]
     init = jnp.broadcast_to(h0_ref[:], (8, tile))
     state, _ = jax.lax.fori_loop(0, 64, round_body, (init, w))
@@ -86,7 +121,7 @@ def sha256_single_block_pallas(
         raise ValueError(f"batch {b} must divide by the lane tile {tile}")
     blk_t = block.astype(jnp.uint32).T  # [16, B]
     out = pl.pallas_call(
-        _kernel,
+        _kernel_looped if interpret else _kernel,
         grid=(b // tile,),
         in_specs=[
             pl.BlockSpec((64, 1), lambda i: (0, 0)),  # K, replicated

@@ -156,11 +156,13 @@ def sha256_single_block(block: jax.Array) -> jax.Array:
 
 
 def _pallas_enabled(batch: int) -> bool:
-    """Opt-in Pallas kernel: CTMR_PALLAS=1, a real TPU backend, and a
-    batch the lane tiling divides (else the XLA path serves)."""
+    """Default-ON for TPU backends (recorded win: 0.50 ms vs 1.48 ms
+    per 16,384-lane fingerprint batch on v5e, bit-exact); requires a
+    batch the lane tiling divides (else the XLA path serves).
+    ``CTMR_PALLAS=0`` opts out."""
     import os
 
-    if os.environ.get("CTMR_PALLAS", "") != "1":
+    if os.environ.get("CTMR_PALLAS", "1") != "1":
         return False
     try:
         if jax.default_backend() != "tpu":
@@ -181,8 +183,8 @@ def sha256_fingerprint64(block: jax.Array) -> jax.Array:
     issuer-count-parity gate (SURVEY.md §7 hard part #2).
 
     Dispatches to the VMEM-resident Pallas kernel
-    (:mod:`ct_mapreduce_tpu.ops.pallas_sha256`) when ``CTMR_PALLAS=1``
-    on TPU; the XLA scan otherwise.
+    (:mod:`ct_mapreduce_tpu.ops.pallas_sha256`) by default on TPU
+    (``CTMR_PALLAS=0`` opts out); the XLA scan otherwise.
     """
     if _pallas_enabled(int(block.shape[0])):
         from ct_mapreduce_tpu.ops import pallas_sha256
