@@ -80,10 +80,9 @@ class ParsedCerts(NamedTuple):
 
 
 class _Rows(NamedTuple):
-    """Word-packed rows: exact f32 halves of big-endian uint32 words."""
+    """Word-packed rows: big-endian uint32 words, padded for slices."""
 
-    hi: jax.Array  # f32[B, NW + _PAD_WORDS] — bits 31..16
-    lo: jax.Array  # f32[B, NW + _PAD_WORDS] — bits 15..0
+    words: jax.Array  # uint32[B, NW + _PAD_WORDS]
     n_words: int  # NW = ceil(L / 4)
 
 
@@ -98,10 +97,7 @@ def _pack_rows(data: jax.Array) -> _Rows:
         | (data[:, 2::4].astype(jnp.uint32) << 8)
         | data[:, 3::4].astype(jnp.uint32)
     )
-    hi = (w >> 16).astype(jnp.float32)
-    lo = (w & 0xFFFF).astype(jnp.float32)
-    pad = ((0, 0), (0, _PAD_WORDS))
-    return _Rows(jnp.pad(hi, pad), jnp.pad(lo, pad), w.shape[1])
+    return _Rows(jnp.pad(w, ((0, 0), (0, _PAD_WORDS))), w.shape[1])
 
 
 # Public names for the shared-rows interface consumed by the fused
@@ -136,16 +132,16 @@ def _window(rows: _Rows, p: jax.Array, n_words: int):
             f"({_PAD_WORDS + 1}); raise _PAD_WORDS"
         )
     base = jnp.clip(p, 0, (nw - 1) * 4) >> 2  # [B]
-    oh = jax.nn.one_hot(base, nw, dtype=jnp.float32)  # [B, NW]
+    # Inline mask-select-reduce in native uint32 (exact by construction
+    # — no dot, no floating point): XLA fuses the iota comparison into
+    # the reduction, so each word read streams ONLY the word slice.
+    iota = jax.lax.broadcasted_iota(jnp.int32, (p.shape[0], nw), 1)
+    oh = iota == base[:, None]
     words = []
     for k in range(n_words):
-        # Explicit multiply+reduce (NOT a dot_general): the f32 halves
-        # carry 16-bit integers, and elementwise f32 arithmetic keeps
-        # them exact regardless of the backend's matmul precision.
-        h = jnp.sum(oh * rows.hi[:, k : k + nw], axis=1)
-        lw = jnp.sum(oh * rows.lo[:, k : k + nw], axis=1)
         words.append(
-            (h.astype(jnp.uint32) << 16) | lw.astype(jnp.uint32)
+            jnp.sum(jnp.where(oh, rows.words[:, k : k + nw], jnp.uint32(0)),
+                    axis=1)
         )
     ww = jnp.stack(words, axis=1)  # uint32[B, n_words]
     win = jnp.stack(
