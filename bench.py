@@ -45,6 +45,7 @@ vs_baseline is against BASELINE.json's 10M entries/sec/chip north star
 
 from __future__ import annotations
 
+import contextlib
 import faulthandler
 import functools
 import json
@@ -350,6 +351,13 @@ def main() -> int:
     log(f"calibration: {per_sweep_s * 1e3:.1f} ms/sweep → "
         f"chunk_sweeps={chunk_sweeps} (cap {max_total_sweeps})")
 
+    # Optional profiler capture of the timed phase (CT_BENCH_PROFILE=
+    # <dir> → a jax.profiler trace viewable in TensorBoard/Perfetto),
+    # the same machinery ct-fetch exposes via the profileDir directive.
+    profile_dir = os.environ.get("CT_BENCH_PROFILE", "")
+    profile_cm = (jax.profiler.trace(profile_dir) if profile_dir
+                  else contextlib.nullcontext())
+
     # Timed chunks: each is one execution; _progress updates between
     # chunks so a watchdog fire still reports the partial measured rate.
     t0 = time.perf_counter()
@@ -357,25 +365,28 @@ def main() -> int:
     processed = 0
     sweeps_done = 0
     chunk = 0
-    while (sweeps_done < max_total_sweeps
-           and (chunk == 0 or time.perf_counter() - t0 < target_total_s)):
-        chunk += 1
-        n_sweeps = min(chunk_sweeps, max_total_sweeps - sweeps_done)
-        epoch_base = (2 + sweeps_done) * n_batches
-        table, fresh_acc, host_acc = mega_step(
-            table, fresh_acc, host_acc,
-            np.int32(epoch_base), np.int32(n_sweeps),
-            datas, lens, issuer_idx, valid)
-        chunk_fresh = int(_fetch(fresh_acc))  # full sync incl. toll
-        now = time.perf_counter()
-        sweeps_done += n_sweeps
-        processed += n_sweeps * n_batches * batch
-        _progress["processed"] = processed
-        _progress["last_sync"] = now
-        log(f"chunk {chunk}: {processed} entries in "
-            f"{now - t0:.3f}s cumulative {processed / (now - t0):,.0f} "
-            f"entries/s (fresh={chunk_fresh})")
+    with profile_cm:
+        while (sweeps_done < max_total_sweeps
+               and (chunk == 0 or time.perf_counter() - t0 < target_total_s)):
+            chunk += 1
+            n_sweeps = min(chunk_sweeps, max_total_sweeps - sweeps_done)
+            epoch_base = (2 + sweeps_done) * n_batches
+            table, fresh_acc, host_acc = mega_step(
+                table, fresh_acc, host_acc,
+                np.int32(epoch_base), np.int32(n_sweeps),
+                datas, lens, issuer_idx, valid)
+            chunk_fresh = int(_fetch(fresh_acc))  # full sync incl. toll
+            now = time.perf_counter()
+            sweeps_done += n_sweeps
+            processed += n_sweeps * n_batches * batch
+            _progress["processed"] = processed
+            _progress["last_sync"] = now
+            log(f"chunk {chunk}: {processed} entries in "
+                f"{now - t0:.3f}s cumulative {processed / (now - t0):,.0f} "
+                f"entries/s (fresh={chunk_fresh})")
     elapsed = time.perf_counter() - t0
+    if profile_dir:
+        log(f"profiler trace written to {profile_dir}")
 
     # Parity gate: every processed entry was unique ⇒ every one must
     # have been inserted exactly once (no silent drops, no collisions).
@@ -440,23 +451,29 @@ def run_e2e() -> dict:
     from ct_mapreduce_tpu.ingest.sync import AggregatorSink, RawBatch
     from ct_mapreduce_tpu.utils import syncerts
 
-    batch = int(os.environ.get("CT_BENCH_E2E_BATCH", "4096"))
-    n_batches = int(os.environ.get("CT_BENCH_E2E_BATCHES", "24"))
-    parity_batches = 2  # prefix replayed through the host-exact path
+    batch = int(os.environ.get("CT_BENCH_E2E_BATCH", "16384"))
+    n_batches = int(os.environ.get("CT_BENCH_E2E_BATCHES", "8"))
+    parity_batches = 1  # prefix replayed through the host-exact path
 
-    tpl = syncerts.make_template()
+    # Two issuers (BASELINE config #3's multi-issuer shape): entries
+    # alternate, so the parity check covers per-issuer attribution too.
+    tpls = [syncerts.make_template(issuer_cn=f"Bench Issuer {k}")
+            for k in range(2)]
     t0 = time.perf_counter()
+    eds_cache = [
+        base64.b64encode(leaflib.encode_extra_data([t.issuer_der])).decode()
+        for t in tpls
+    ]
     raw_batches = []
     for i in range(n_batches):
         lis, eds = [], []
         for j in range(batch):
-            der = syncerts.stamp_serial(tpl, i * batch + j)
+            k = j & 1
+            der = syncerts.stamp_serial(tpls[k], i * batch + j)
             lis.append(base64.b64encode(
                 leaflib.encode_leaf_input(der, 1_700_000_000_000 + j)
             ).decode())
-            eds.append(base64.b64encode(
-                leaflib.encode_extra_data([tpl.issuer_der])
-            ).decode())
+            eds.append(eds_cache[k])
         raw_batches.append(RawBatch(lis, eds, i * batch, "bench-log"))
     log(f"e2e setup: {n_batches}x{batch} wire entries in "
         f"{time.perf_counter() - t0:.1f}s")
@@ -514,6 +531,22 @@ def run_e2e() -> dict:
         )
     if sorted(host_snap.issuers()) != sorted(snap.issuers()):
         raise BenchError("e2e parity mismatch: issuer sets differ")
+
+    # Per-issuer attribution: entries alternate issuers exactly, so
+    # both lanes must report a perfect split (the reference's
+    # per-issuer serial counts, storage-statistics.go:28-99).
+    def per_issuer(s):
+        out: dict = {}
+        for (iss, _exp), c in s.counts.items():
+            out[iss] = out.get(iss, 0) + c
+        return out
+
+    dev_by_iss = per_issuer(snap)
+    host_by_iss = per_issuer(host_snap)
+    if sorted(dev_by_iss.values()) != [total // 2] * 2:
+        raise BenchError(f"e2e issuer split wrong on device: {dev_by_iss}")
+    if sorted(host_by_iss.values()) != [parity_total // 2] * 2:
+        raise BenchError(f"e2e issuer split wrong on host: {host_by_iss}")
     return {
         "e2e_entries_per_sec": round(rate, 1),
         "e2e_entries": total,
