@@ -384,7 +384,9 @@ def main() -> int:
             log(f"chunk {chunk}: {processed} entries in "
                 f"{now - t0:.3f}s cumulative {processed / (now - t0):,.0f} "
                 f"entries/s (fresh={chunk_fresh})")
-    elapsed = time.perf_counter() - t0
+        # Inside the with-block: profiler teardown (trace serialization)
+        # must not count against the measured rate.
+        elapsed = time.perf_counter() - t0
     if profile_dir:
         log(f"profiler trace written to {profile_dir}")
 
@@ -543,9 +545,14 @@ def run_e2e() -> dict:
 
     dev_by_iss = per_issuer(snap)
     host_by_iss = per_issuer(host_snap)
-    if sorted(dev_by_iss.values()) != [total // 2] * 2:
+    # Entries alternate k = j & 1 per batch: issuer 0 takes ceil(b/2).
+    dev_split = sorted([n_batches * (batch // 2),
+                        n_batches * ((batch + 1) // 2)])
+    host_split = sorted([parity_batches * (batch // 2),
+                         parity_batches * ((batch + 1) // 2)])
+    if sorted(dev_by_iss.values()) != dev_split:
         raise BenchError(f"e2e issuer split wrong on device: {dev_by_iss}")
-    if sorted(host_by_iss.values()) != [parity_total // 2] * 2:
+    if sorted(host_by_iss.values()) != host_split:
         raise BenchError(f"e2e issuer split wrong on host: {host_by_iss}")
     return {
         "e2e_entries_per_sec": round(rate, 1),

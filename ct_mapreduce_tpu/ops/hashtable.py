@@ -32,13 +32,20 @@ not an occupied mismatch:
   wins and writes key+meta (winners hold unique slots, so those
   scatters never see duplicate indices — XLA's duplicate-index
   scatter is specified per element, not per row, so a whole-row CAS
-  could tear). Losers advance ``r`` TO the contested position and
-  re-examine it next round — now occupied, it resolves as a match (a
-  within-batch duplicate: first-in-lane-order wins, exactly Redis
-  SADD semantics when the reference stores the same serial twice) or
-  a mismatch (probe on);
+  could tear). Losers resolve IN the same round by re-reading the
+  contested slot after the winner's write: the winner's key matching
+  theirs means a within-batch duplicate (done, ``was_unknown=False``
+  — first-in-lane-order wins, exactly Redis SADD semantics when the
+  reference stores the same serial twice); a different key means the
+  chain moved — probe on past the slot;
 - all window positions occupied by other keys → ``r`` advances past
   the window.
+
+Random-access ops (gather/scatter on the HBM-resident table) carry a
+large fixed per-op cost on TPU, so the structure minimizes OP COUNT
+per round (5 table-touching ops, no claim reset — a slot is contended
+at most once per call) and ROUND COUNT (losers resolve in-round;
+windows cover W chain positions per gather).
 
 A key always lands at the FIRST empty slot of its probe chain (losers
 never skip the contested slot), so ``contains``' probe-until-empty
@@ -133,10 +140,11 @@ def insert(
     lane = jnp.arange(b, dtype=jnp.int32)
     no_lane = jnp.int32(2**31 - 1)
     W = min(PROBE_WIDTH, max_probes)
-    # A lane can lose one election per slot before the slot resolves,
-    # so the round budget is 2×max_probes (+1 slack); lanes that leave
-    # the loop still pending are overflow → exact host lane.
-    max_rounds = 2 * max_probes + 1
+    # Every pending lane advances its probe index by ≥1 per round
+    # (losers resolve in-round and skip past the contested slot), so
+    # max_probes + 1 rounds bound the loop; lanes that leave the loop
+    # still pending are overflow → exact host lane.
+    max_rounds = max_probes + 1
 
     def cond(carry):
         rounds, _r, _tk, _tm, _claim, pending, _found, _inserted, _ovf = carry
@@ -162,7 +170,10 @@ def insert(
         slot = sel(slots, jstar[:, None], 1)[:, 0]
         # Deterministic election at each lane's first-empty slot:
         # scatter-min lane ids (min commutes ⇒ duplicate indices are
-        # safe), read back; the surviving lane id is the winner.
+        # safe), read back; the surviving lane id is the winner. No
+        # reset pass is needed: a slot is contended at most once per
+        # insert call — its election always produces a winner, who
+        # occupies it, so no later round can see it empty again.
         cslot = jnp.where(empty, slot, capacity)  # OOB rows are dropped
         claim = claim.at[cslot].min(lane, mode="drop")
         winner = empty & (claim[slot] == lane)
@@ -170,14 +181,21 @@ def insert(
         wslot = jnp.where(winner, slot, capacity)
         table_keys = table_keys.at[wslot].set(keys, mode="drop")
         table_meta = table_meta.at[wslot].set(meta, mode="drop")
-        # Reset only the touched claim slots for the next round.
-        claim = claim.at[cslot].set(no_lane, mode="drop")
-        found = found | match
+        # Resolve election losers IN-ROUND (random-access ops have a
+        # large fixed cost on TPU, so an extra gather here is far
+        # cheaper than an extra round): re-read the contested slot —
+        # losers whose key now sits there are within-batch duplicates
+        # (done, known); distinct-key losers probe on past the slot.
+        cur2 = table_keys[slot]  # [B, 4]
+        loser = empty & ~winner
+        loser_match = loser & jnp.all(cur2 == keys, axis=-1)
+        found = found | match | loser_match
         inserted = inserted | winner
-        pending = pending & ~match & ~winner
-        # Election losers advance r TO the contested position (they
-        # re-examine it next round); miss-through lanes skip the window.
-        r = jnp.where(pending, jnp.where(any_stop, r + jstar, r + W), r)
+        pending = pending & ~match & ~winner & ~loser_match
+        # Remaining pending lanes continue past what they examined:
+        # distinct-key losers past the contested position, miss-through
+        # lanes past the whole window.
+        r = jnp.where(pending, jnp.where(any_stop, r + jstar + 1, r + W), r)
         # A lane that exhausts its probe chain is overflow — record it
         # and drop it from pending so the loop can terminate early.
         exhausted = pending & (r >= max_probes)
