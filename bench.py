@@ -543,6 +543,30 @@ def run_e2e() -> dict:
             out[iss] = out.get(iss, 0) + c
         return out
 
+    # BASELINE config #2's shape (issuerCNFilter, noop backend): replay
+    # a prefix with the CN filter matching only issuer 0 — exactly that
+    # half may land, the rest must be filtered ON DEVICE.
+    cn_agg = TpuAggregator(capacity=1 << 17, batch_size=batch,
+                           cn_prefixes=("Bench Issuer 0",))
+    cn_sink = AggregatorSink(cn_agg, flush_size=batch, device_queue_depth=2)
+    for rb in raw_batches[:parity_batches]:
+        cn_sink.store_raw_batch(rb)
+    cn_sink.flush()
+    cn_total = cn_agg.drain().total
+    cn_want = parity_batches * ((batch + 1) // 2)
+    cn_filtered = cn_agg.metrics["filtered_cn"]
+    log(f"e2e CN filter: kept {cn_total} (want {cn_want}), "
+        f"device-filtered {cn_filtered}")
+    if cn_total != cn_want:
+        raise BenchError(
+            f"e2e CN-filter parity: kept {cn_total} != {cn_want}"
+        )
+    if cn_filtered != parity_batches * batch - cn_want:
+        raise BenchError(
+            f"e2e CN-filter parity: filtered {cn_filtered} != "
+            f"{parity_batches * batch - cn_want}"
+        )
+
     dev_by_iss = per_issuer(snap)
     host_by_iss = per_issuer(host_snap)
     # Entries alternate k = j & 1 per batch: issuer 0 takes ceil(b/2).
