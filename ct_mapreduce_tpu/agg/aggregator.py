@@ -385,7 +385,9 @@ class TpuAggregator:
         """Read back one chunk's device outputs and fold them into
         ``res``; the blocking half of the step."""
         hl = np.asarray(out.host_lane)
-        wu = np.asarray(out.was_unknown)
+        # np.array (copy), not asarray: device arrays give read-only
+        # views and the cross-encoding guard below may flip lanes.
+        wu = np.array(out.was_unknown)
         nah = np.asarray(out.not_after_hour)
         slen = np.asarray(out.serial_len)
         sarr = np.asarray(out.serials)
@@ -423,7 +425,7 @@ class TpuAggregator:
         keep = okm & ~f_l
         kp, kl = pos_arr[keep], lanes[keep]
         res.exp_hours[kp] = nah[kl]
-        if self.want_serials or self.host_serials:
+        if self.want_serials:
             for p_, l_ in zip(kp, kl):
                 sb = sarr[l_, : slen[l_]].tobytes()
                 res.serials[p_] = sb
@@ -432,9 +434,17 @@ class TpuAggregator:
                     key = (int(batch.issuer_idx[l_]), int(nah[l_]))
                     if sb in self.host_serials.get(key, ()):
                         wu[l_] = False
+                        # Keep the running per-issuer gauge consistent
+                        # with the corrected report.
+                        self.issuer_totals[int(batch.issuer_idx[l_])] -= 1
                     else:
                         res.was_unknown[p_] = True
         else:
+            # Count-only sinks stay on the vectorized path permanently:
+            # exact totals are guaranteed by drain()'s batched overlap
+            # subtraction, so no per-entry guard (or serial bytes) are
+            # needed here. was_unknown may over-report on the
+            # pathological host-then-device duplicate; counts cannot.
             res.was_unknown[kp[wu[kl]]] = True
         self._accumulate_metadata_lanes(
             batch, out, lanes, pos_arr, res.was_unknown
@@ -656,11 +666,26 @@ class TpuAggregator:
                 idx, eh = packing.unpack_meta(int(m), self.base_hour)
                 key = self._count_key(idx, eh)
                 counts[key] = counts.get(key, 0) + int(c)
+        # Host-lane serials that ALSO landed in the device table would
+        # double count (host-first-then-device duplicate encodings of
+        # one (issuer, serial, expiry) identity — the reference's
+        # single SADD set counts once). One batched membership probe
+        # finds the overlap; overlapping serials count device-side only.
+        items = [
+            (idx, eh, sb)
+            for (idx, eh), serials in self.host_serials.items()
+            for sb in serials
+        ]
+        overlap: dict[tuple[int, int], int] = {}
+        for (idx, eh, _sb), dup in zip(items, self._device_known_flags(items)):
+            if dup:
+                overlap[(idx, eh)] = overlap.get((idx, eh), 0) + 1
         for (idx, eh), serials in self.host_serials.items():
-            if not serials:
+            n = len(serials) - overlap.get((idx, eh), 0)
+            if n <= 0:
                 continue
             key = self._count_key(idx, eh)
-            counts[key] = counts.get(key, 0) + len(serials)
+            counts[key] = counts.get(key, 0) + n
         crls = {
             self.registry.issuer_at(i).id(): set(s) for i, s in self.crl_sets.items()
         }

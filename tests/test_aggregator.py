@@ -13,6 +13,7 @@ import pytest
 
 from ct_mapreduce_tpu.agg import TpuAggregator
 from ct_mapreduce_tpu.core import der as hostder
+from ct_mapreduce_tpu.core import packing
 from ct_mapreduce_tpu.core.types import ExpDate, Issuer
 
 from certgen import make_cert, spki_of
@@ -148,6 +149,42 @@ def test_expiring_this_hour_exact_boundary():
     # Both boundary-bucket lanes took the exact host lane.
     assert res.host_lane_count == 2
     assert a.drain().total == 2
+
+
+def test_host_then_device_duplicate_counts_once():
+    """The pathological cross-encoding order — an oversized cert takes
+    the host lane FIRST, then a device-sized cert with the same
+    (issuer, serial, expiry) identity arrives — must still count once,
+    like the reference's single SADD set. Count-only sinks rely on
+    drain()'s batched overlap subtraction; serial-materializing sinks
+    additionally get the per-entry report corrected in-flight."""
+    for want_serials in (False, True):
+        a = agg(capacity=1 << 12, batch_size=16)
+        a.want_serials = want_serials
+        _host_then_device(a, want_serials)
+
+
+def _host_then_device(a, want_serials):
+    ca = make_cert(issuer_cn="Guard CA")
+    exp = datetime.datetime(2031, 6, 15, 14, 0, tzinfo=UTC)
+    big = make_cert(
+        serial=0xABCD, issuer_cn="Guard CA", subject_cn="big.example.com",
+        is_ca=False, not_after=exp,
+        crl_dps=tuple(f"http://crl{i}.g.example/{'q' * 90}.crl"
+                      for i in range(80)),
+    )
+    small = make_cert(serial=0xABCD, issuer_cn="Guard CA",
+                      subject_cn="small.example.com", is_ca=False,
+                      not_after=exp)
+    assert len(big) > packing.LENGTH_BUCKETS[-1] >= len(small)
+    r1 = a.ingest([(big, ca)])  # oversized → exact host lane
+    assert r1.was_unknown[0] and r1.host_lane_count == 1
+    r2 = a.ingest([(small, ca)])  # device lane, same (issuer, serial, hour)
+    if want_serials:
+        # In-flight guard corrects the per-entry report too.
+        assert not r2.was_unknown[0]
+    assert a.drain().total == 1  # the counting contract, both modes
+    assert a.drain().total == 1  # drain is idempotent
 
 
 def test_boundary_migration_no_double_count():

@@ -396,6 +396,42 @@ def test_device_queue_depth_pipelines_submissions():
     assert com_ids == sub_ids
 
 
+def test_raw_batch_row_narrowing():
+    """When every cert fits half the pad, the sink ships the narrow row
+    view (H2D bytes halve on tunneled links) and results are identical."""
+    import base64
+
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.ingest.sync import RawBatch
+
+    issuer_der = certgen.make_cert(serial=1, issuer_cn="Narrow CA",
+                                   is_ca=True, not_after=FUTURE)
+    lis, eds = [], []
+    for s in (61, 62, 63):
+        der = certgen.make_cert(serial=s, issuer_cn="Narrow CA",
+                                is_ca=False, not_after=FUTURE)
+        assert len(der) <= 1024
+        lis.append(base64.b64encode(leaflib.encode_leaf_input(der, 1)).decode())
+        eds.append(base64.b64encode(
+            leaflib.encode_extra_data([issuer_der])).decode())
+
+    agg = TpuAggregator(capacity=1 << 12, batch_size=16,
+                        now=datetime.datetime(2025, 1, 1, tzinfo=UTC))
+    seen_widths = []
+    orig = agg.ingest_packed_submit
+
+    def spy(data, *a, **kw):
+        seen_widths.append(data.shape[1])
+        return orig(data, *a, **kw)
+
+    agg.ingest_packed_submit = spy
+    sink = AggregatorSink(agg, flush_size=16)
+    sink.store_raw_batch(RawBatch(lis, eds, 0, "log"))
+    sink.flush()
+    assert seen_widths == [sink.PAD_LEN // 2]  # narrow view shipped
+    assert agg.drain().total == 3
+
+
 # -- health -----------------------------------------------------------------
 
 
