@@ -212,6 +212,12 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    # Honor JAX_PLATFORMS=cpu for local smoke runs: the ambient axon
+    # sitecustomize imports jax early, so the env var alone is too late
+    # (same workaround as tests/conftest.py).
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
     from ct_mapreduce_tpu.core import packing
     from ct_mapreduce_tpu.ops import hashtable, pipeline
     from ct_mapreduce_tpu.utils import syncerts
@@ -220,10 +226,10 @@ def main() -> int:
     # (hash-table gather/scatter) cost ~5 ms per op nearly independent
     # of batch width (measured: 4.7 ms at 16K lanes, 5.4 ms at 262K),
     # so per-entry insert cost falls ~linearly with batch size.
-    batch = int(os.environ.get("CT_BENCH_BATCH", "131072"))
-    n_batches = int(os.environ.get("CT_BENCH_RESIDENT", "2"))
+    batch = int(os.environ.get("CT_BENCH_BATCH", "1048576"))
+    n_batches = int(os.environ.get("CT_BENCH_RESIDENT", "1"))
     pad_len = int(os.environ.get("CT_BENCH_PADLEN", "1024"))
-    capacity = 1 << int(os.environ.get("CT_BENCH_LOG2_CAPACITY", "26"))
+    capacity = 1 << int(os.environ.get("CT_BENCH_LOG2_CAPACITY", "27"))
     # Timed phase: device executions (jitted lax.fori_loop over sweeps ×
     # resident batches), each synced by a value read. Execution length
     # is calibrated so one execution ≈ exec_target_s (a >~20s execution
@@ -251,16 +257,32 @@ def main() -> int:
     tpl = syncerts.make_template()
     now_hour = 500_000  # well before the template's 2031 expiry
 
-    # Resident batches, stacked [G, B, L]: lane bytes unique per
-    # (batch, lane); epoch bytes stamped on device each sweep.
-    datas = np.zeros((n_batches, batch, pad_len), np.uint8)
-    lens = np.zeros((n_batches, batch), np.int32)
-    for i in range(n_batches):
-        datas[i], lens[i] = syncerts.stamp_batch_array(
-            tpl, start=i * batch, batch=batch, pad_len=pad_len
-        )
-    datas = jax.device_put(datas)
-    lens = jax.device_put(lens)
+    # Resident batches, stacked [G, B, L], built ON DEVICE from the
+    # ~1 KB signed template: broadcast the template row, then stamp a
+    # per-(batch, lane) counter into serial bytes 12..16 (epoch bytes
+    # 4..8 are restamped per sweep inside mega_step). H2D at setup is
+    # one template, not gigabytes — on the tunneled dev link the old
+    # host-stamped [G, B, L] upload took longer than the measurement.
+    base = np.frombuffer(tpl.leaf_der, dtype=np.uint8)
+    if base.size > pad_len:
+        raise BenchError(f"template {base.size}B > pad {pad_len}")
+    tlen = int(base.size)
+    lane_cols = tpl.serial_off + np.arange(12, 16, dtype=np.int32)
+
+    @jax.jit
+    def build_batches(base_row):
+        row = jnp.zeros((pad_len,), jnp.uint8).at[:tlen].set(base_row)
+        data = jnp.broadcast_to(row, (n_batches, batch, pad_len))
+        cnt = (jnp.arange(n_batches, dtype=jnp.uint32)[:, None] * batch
+               + jnp.arange(batch, dtype=jnp.uint32)[None, :])
+        cb = jnp.stack(
+            [(cnt >> 24) & 0xFF, (cnt >> 16) & 0xFF,
+             (cnt >> 8) & 0xFF, cnt & 0xFF], axis=-1
+        ).astype(jnp.uint8)
+        return data.at[:, :, lane_cols].set(cb)
+
+    datas = build_batches(jax.device_put(base))
+    lens = jnp.full((n_batches, batch), tlen, dtype=jnp.int32)
     issuer_idx = jax.device_put(np.zeros((batch,), np.int32))
     valid = jax.device_put(np.ones((batch,), bool))
     epoch_cols = tpl.serial_off + np.arange(4, 8, dtype=np.int32)
@@ -290,7 +312,8 @@ def main() -> int:
         def batch_body(g, carry):
             table, fresh_acc, host_acc, sweep = carry
             # Unique serials per (sweep, batch): write the epoch uint32
-            # into serial bytes 4..8 (lane counter occupies bytes 8..16).
+            # into serial bytes 4..8 (the uint32 lane counter occupies
+            # bytes 12..16 — unique up to 2^32 lanes per sweep).
             e = (epoch_base + sweep * g_count + g).astype(jnp.uint32)
             eb = jnp.stack(
                 [(e >> 24) & 0xFF, (e >> 16) & 0xFF, (e >> 8) & 0xFF,
@@ -481,23 +504,32 @@ def run_e2e() -> dict:
         f"{time.perf_counter() - t0:.1f}s")
 
     # Warmup run on a throwaway aggregator: compiles the batch-shaped
-    # ingest step once so the timed replay measures steady state.
+    # ingest step once so the timed replay measures steady state. The
+    # table capacity is part of the compiled shape — warm with the SAME
+    # capacity as the timed aggregator, or the first timed dispatch
+    # recompiles (~26s observed on the tunneled stack, r03 postmortem).
+    capacity = 1 << max(17, (n_batches * batch).bit_length() + 1)
     t0 = time.perf_counter()
-    warm_agg = TpuAggregator(capacity=1 << 17, batch_size=batch)
+    warm_agg = TpuAggregator(capacity=capacity, batch_size=batch)
     warm_sink = AggregatorSink(warm_agg, flush_size=batch,
                                device_queue_depth=2)
     warm_sink.store_raw_batch(raw_batches[0])
     warm_sink.flush()
     log(f"e2e warmup (compile): {time.perf_counter() - t0:.1f}s")
+    # Free the warmup table before the timed run — the jit cache is
+    # keyed by shapes, not object lifetime, so the compiled step
+    # survives while the duplicate full-capacity buffers do not.
+    del warm_sink, warm_agg
 
-    agg = TpuAggregator(
-        capacity=1 << max(17, (n_batches * batch).bit_length() + 1),
-        batch_size=batch,
-    )
+    agg = TpuAggregator(capacity=capacity, batch_size=batch)
     sink = AggregatorSink(agg, flush_size=batch, device_queue_depth=2)
     t0 = time.perf_counter()
-    for rb in raw_batches:
+    t_prev = t0
+    for i, rb in enumerate(raw_batches):
         sink.store_raw_batch(rb)
+        t_now = time.perf_counter()
+        log(f"e2e batch {i + 1}/{n_batches}: +{t_now - t_prev:.2f}s")
+        t_prev = t_now
     sink.flush()
     snap = agg.drain()
     elapsed = time.perf_counter() - t0

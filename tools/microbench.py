@@ -1,0 +1,136 @@
+"""Per-component device-step microbenchmark on the real chip.
+
+Times, at one batch width, the stages of the fused step in isolation:
+  h2d     — device_put of the packed batch (tunnel/PCIe bandwidth)
+  parse   — der_kernel.parse_certs (rows pack + TLV walk)
+  sha     — fingerprint build + SHA-256 (one 64B block/lane)
+  insert  — hashtable.insert (all-fresh worst case)
+  fused   — pipeline.ingest_core (optional: CT_MB_FUSED=1)
+Each stage prints immediately (unbuffered) so a killed run still
+leaves partial results. Run:  python tools/microbench.py [batch]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def say(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def timeit(fn, sync, n=3):
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        sync(fn())
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ct_mapreduce_tpu.core import packing
+    from ct_mapreduce_tpu.ops import der_kernel, hashtable, pipeline
+    from ct_mapreduce_tpu.utils import syncerts
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 131072
+    pad_len = int(os.environ.get("CT_MB_PADLEN", "1024"))
+    cap = 1 << int(os.environ.get("CT_MB_LOG2_CAP", "26"))
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    say(f"device: {dev.platform} ({dev.device_kind}) "
+        f"acquired in {time.perf_counter() - t0:.1f}s; batch={batch}")
+    sync = jax.block_until_ready
+
+    tpl = syncerts.make_template()
+    t0 = time.perf_counter()
+    data_np, len_np = syncerts.stamp_batch_array(
+        tpl, start=0, batch=batch, pad_len=pad_len)
+    say(f"host pack: {time.perf_counter() - t0:.1f}s "
+        f"({batch * pad_len / 2**20:.0f} MB)")
+
+    t0 = time.perf_counter()
+    data = sync(jax.device_put(data_np))
+    dt = time.perf_counter() - t0
+    say(f"h2d: {dt:.2f}s = {batch * pad_len / 2**20 / dt:.1f} MB/s")
+    length = sync(jax.device_put(len_np))
+    issuer_idx = sync(jax.device_put(np.zeros((batch,), np.int32)))
+    valid = sync(jax.device_put(np.ones((batch,), bool)))
+
+    def report(name, t):
+        say(f"{name:7s} {t * 1e3:9.2f} ms  {batch / t / 1e6:7.2f} M/s")
+
+    parse = jax.jit(der_kernel.parse_certs)
+    t0 = time.perf_counter()
+    p = sync(parse(data, length))
+    say(f"parse compile+run: {time.perf_counter() - t0:.1f}s")
+    report("parse", timeit(lambda: parse(data, length), sync))
+
+    rows = der_kernel.pack_rows(data)
+    serials, _ = der_kernel.gather_serials_rows(
+        rows, p.serial_off, p.serial_len, packing.MAX_SERIAL_BYTES)
+    serials = sync(serials)
+    fp = jax.jit(pipeline.fingerprints)
+    t0 = time.perf_counter()
+    f = sync(fp(issuer_idx, p.not_after_hour, serials, p.serial_len))
+    say(f"sha compile+run: {time.perf_counter() - t0:.1f}s")
+    report("sha", timeit(lambda: fp(issuer_idx, p.not_after_hour, serials,
+                                    p.serial_len), sync))
+
+    meta = jnp.zeros((batch,), jnp.uint32)
+    ins = jax.jit(lambda tbl, k: hashtable.insert(tbl, k, meta, valid),
+                  donate_argnums=(0,))
+    stamp = jax.jit(lambda f, e: f.at[:, 3].set(
+        f[:, 3] ^ (e.astype(jnp.uint32) << 20)))
+    tbl = hashtable.make_table(cap)
+    t0 = time.perf_counter()
+    tbl, wu, ovf = ins(tbl, stamp(f, jnp.uint32(0)))
+    sync(wu)
+    say(f"insert compile+run: {time.perf_counter() - t0:.1f}s")
+    ts = []
+    for e in range(1, 4):
+        k = sync(stamp(f, jnp.uint32(e)))
+        t0 = time.perf_counter()
+        tbl, wu, ovf = ins(tbl, k)
+        sync(wu)
+        ts.append(time.perf_counter() - t0)
+    report("insert", float(np.median(ts)))
+
+    if os.environ.get("CT_MB_FUSED", "0") == "1":
+        ecols = tpl.serial_off + np.arange(4, 8, dtype=np.int32)
+
+        def fused(tbl2, d, e):
+            eb = jnp.stack([(e >> 24) & 0xFF, (e >> 16) & 0xFF,
+                            (e >> 8) & 0xFF, e & 0xFF]).astype(jnp.uint8)
+            d = d.at[:, ecols].set(eb[None, :])
+            return pipeline.ingest_core(
+                tbl2, d, length, issuer_idx, valid,
+                jnp.int32(500_000), jnp.int32(packing.DEFAULT_BASE_HOUR),
+                jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0,), jnp.int32))
+
+        fused_j = jax.jit(fused, donate_argnums=(0,))
+        tbl2 = hashtable.make_table(cap)
+        t0 = time.perf_counter()
+        tbl2, out = fused_j(tbl2, data, jnp.uint32(100))
+        sync(out.was_unknown)
+        say(f"fused compile+run: {time.perf_counter() - t0:.1f}s")
+        ts = []
+        for e in range(101, 104):
+            t0 = time.perf_counter()
+            tbl2, out = fused_j(tbl2, data, jnp.uint32(e))
+            sync(out.was_unknown)
+            ts.append(time.perf_counter() - t0)
+        report("fused", float(np.median(ts)))
+
+
+if __name__ == "__main__":
+    main()

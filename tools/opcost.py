@@ -1,0 +1,142 @@
+"""Raw XLA op-cost probe for the random-access primitives the dedup
+table is built from: gather / scatter / scatter-min on an HBM-resident
+table, at several table sizes, plus batch sort. Each measurement runs
+R repetitions of the op INSIDE one jitted fori_loop (so per-dispatch
+overhead is excluded — same structure as the bench's mega_step) and
+reports per-op device time. Prints immediately per stage.
+
+Run: python tools/opcost.py [batch]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def say(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+REPS = int(os.environ.get("CT_OC_REPS", "32"))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 131072
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    say(f"device: {dev.platform} ({dev.device_kind}) "
+        f"in {time.perf_counter() - t0:.1f}s; batch={batch} reps={REPS}")
+    sync = jax.block_until_ready
+
+    key = jax.random.PRNGKey(0)
+    idx = jax.random.randint(key, (batch,), 0, 1 << 20, dtype=jnp.int32)
+    vals = jax.random.randint(key, (batch, 4), 0, 2**31 - 1,
+                              dtype=jnp.int32).astype(jnp.uint32)
+    lane = jnp.arange(batch, dtype=jnp.int32)
+    sync((idx, vals))
+
+    def loop_time(body, init, reps=REPS):
+        """Median wall time per rep of body, run inside one execution."""
+        fn = jax.jit(lambda c: jax.lax.fori_loop(0, reps, body, c),
+                     donate_argnums=(0,))
+        c = fn(init)          # compile + first run
+        sync(c)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            c = fn(c)
+            sync(c)
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)) / reps, c
+
+    for log2cap in (21, 24, 26):
+        cap = 1 << log2cap
+        table = jnp.zeros((cap, 4), jnp.uint32)
+        slots = (idx * 7919) & (cap - 1)
+        mb = cap * 16 / 2**20
+
+        # gather rows
+        def g_body(i, c):
+            t, acc = c
+            cur = t[(slots + i) & (cap - 1)]
+            return t, acc + cur.sum(dtype=jnp.uint32)
+
+        dt, _ = loop_time(g_body, (table, jnp.uint32(0)))
+        say(f"cap 2^{log2cap} ({mb:5.0f}MB): gather-row   "
+            f"{dt * 1e3:7.3f} ms/op")
+
+        # scatter rows (set)
+        def s_body(i, c):
+            t, = c
+            t = t.at[(slots + i) & (cap - 1)].set(vals, mode="drop")
+            return (t,)
+
+        dt, _ = loop_time(s_body, (table,))
+        say(f"cap 2^{log2cap} ({mb:5.0f}MB): scatter-row  "
+            f"{dt * 1e3:7.3f} ms/op")
+
+        # scatter-min on int32[cap]
+        claim = jnp.full((cap,), 2**31 - 1, jnp.int32)
+
+        def m_body(i, c):
+            t, = c
+            t = t.at[(slots + i) & (cap - 1)].min(lane, mode="drop")
+            return (t,)
+
+        dt, _ = loop_time(m_body, (claim,))
+        say(f"cap 2^{log2cap} ({mb / 4:5.0f}MB): scatter-min  "
+            f"{dt * 1e3:7.3f} ms/op")
+
+        # full-array fill (the per-call claim reset)
+        def f_body(i, c):
+            t, = c
+            t = jnp.full((cap,), 2**31 - 1, jnp.int32) + i
+            return (t,)
+
+        dt, _ = loop_time(f_body, (claim,))
+        say(f"cap 2^{log2cap} ({mb / 4:5.0f}MB): fill         "
+            f"{dt * 1e3:7.3f} ms/op")
+
+    # sort of the batch (64-bit packed as 2x uint32 lexsort vs single)
+    k64 = vals[:, 0].astype(jnp.uint64) << 32 | vals[:, 1].astype(jnp.uint64)
+
+    def sort_body(i, c):
+        k, acc = c
+        s = jnp.sort(k + i.astype(jnp.uint64))
+        return k, acc + s[0]
+
+    dt, _ = loop_time(sort_body, (k64, jnp.uint64(0)), reps=8)
+    say(f"sort u64[{batch}]: {dt * 1e3:7.3f} ms/op")
+
+    def argsort_body(i, c):
+        k, acc = c
+        s = jnp.argsort(k + i.astype(jnp.uint64))
+        return k, acc + s[0]
+
+    dt, _ = loop_time(argsort_body, (k64, jnp.int32(0)), reps=8)
+    say(f"argsort u64[{batch}]: {dt * 1e3:7.3f} ms/op")
+
+    # gather/scatter over the BATCH (small array) for comparison
+    small = jnp.zeros((batch, 4), jnp.uint32)
+    sidx = (idx * 31) & (batch - 1) if batch & (batch - 1) == 0 else idx % batch
+
+    def gs_body(i, c):
+        t, acc = c
+        cur = t[(sidx + i) % batch]
+        return t.at[(sidx + i) % batch].set(cur + 1, mode="drop"), acc
+
+    dt, _ = loop_time(gs_body, (small, jnp.uint32(0)))
+    say(f"batch-sized gather+scatter [{batch},4]: {dt * 1e3:7.3f} ms/op")
+
+
+if __name__ == "__main__":
+    main()
