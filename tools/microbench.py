@@ -38,6 +38,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
     from ct_mapreduce_tpu.core import packing
     from ct_mapreduce_tpu.ops import der_kernel, hashtable, pipeline
     from ct_mapreduce_tpu.utils import syncerts
@@ -86,21 +89,23 @@ def main():
     report("sha", timeit(lambda: fp(issuer_idx, p.not_after_hour, serials,
                                     p.serial_len), sync))
 
+    # Committed device buffers must be jit ARGUMENTS, never closures —
+    # a closure over one permanently degrades dispatch on the axon
+    # stack (see bench.py's CRITICAL note).
     meta = jnp.zeros((batch,), jnp.uint32)
-    ins = jax.jit(lambda tbl, k: hashtable.insert(tbl, k, meta, valid),
-                  donate_argnums=(0,))
+    ins = jax.jit(hashtable.insert, donate_argnums=(0,))
     stamp = jax.jit(lambda f, e: f.at[:, 3].set(
         f[:, 3] ^ (e.astype(jnp.uint32) << 20)))
     tbl = hashtable.make_table(cap)
     t0 = time.perf_counter()
-    tbl, wu, ovf = ins(tbl, stamp(f, jnp.uint32(0)))
+    tbl, wu, ovf = ins(tbl, stamp(f, jnp.uint32(0)), meta, valid)
     sync(wu)
     say(f"insert compile+run: {time.perf_counter() - t0:.1f}s")
     ts = []
     for e in range(1, 4):
         k = sync(stamp(f, jnp.uint32(e)))
         t0 = time.perf_counter()
-        tbl, wu, ovf = ins(tbl, k)
+        tbl, wu, ovf = ins(tbl, k, meta, valid)
         sync(wu)
         ts.append(time.perf_counter() - t0)
     report("insert", float(np.median(ts)))
@@ -108,25 +113,27 @@ def main():
     if os.environ.get("CT_MB_FUSED", "0") == "1":
         ecols = tpl.serial_off + np.arange(4, 8, dtype=np.int32)
 
-        def fused(tbl2, d, e):
+        def fused(tbl2, d, ln, ii, vd, e):
             eb = jnp.stack([(e >> 24) & 0xFF, (e >> 16) & 0xFF,
                             (e >> 8) & 0xFF, e & 0xFF]).astype(jnp.uint8)
             d = d.at[:, ecols].set(eb[None, :])
             return pipeline.ingest_core(
-                tbl2, d, length, issuer_idx, valid,
+                tbl2, d, ln, ii, vd,
                 jnp.int32(500_000), jnp.int32(packing.DEFAULT_BASE_HOUR),
                 jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0,), jnp.int32))
 
         fused_j = jax.jit(fused, donate_argnums=(0,))
         tbl2 = hashtable.make_table(cap)
         t0 = time.perf_counter()
-        tbl2, out = fused_j(tbl2, data, jnp.uint32(100))
+        tbl2, out = fused_j(tbl2, data, length, issuer_idx, valid,
+                            jnp.uint32(100))
         sync(out.was_unknown)
         say(f"fused compile+run: {time.perf_counter() - t0:.1f}s")
         ts = []
         for e in range(101, 104):
             t0 = time.perf_counter()
-            tbl2, out = fused_j(tbl2, data, jnp.uint32(e))
+            tbl2, out = fused_j(tbl2, data, length, issuer_idx, valid,
+                                jnp.uint32(e))
             sync(out.was_unknown)
             ts.append(time.perf_counter() - t0)
         report("fused", float(np.median(ts)))

@@ -30,6 +30,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 131072
     t0 = time.perf_counter()
     dev = jax.devices()[0]
@@ -37,18 +40,23 @@ def main():
         f"in {time.perf_counter() - t0:.1f}s; batch={batch} reps={REPS}")
     sync = jax.block_until_ready
 
-    key = jax.random.PRNGKey(0)
-    idx = jax.random.randint(key, (batch,), 0, 1 << 20, dtype=jnp.int32)
-    vals = jax.random.randint(key, (batch, 4), 0, 2**31 - 1,
-                              dtype=jnp.int32).astype(jnp.uint32)
-    lane = jnp.arange(batch, dtype=jnp.int32)
-    sync((idx, vals))
+    # NumPy closures lower to HLO literals — no committed device
+    # buffers may be closed over by jitted bodies (the axon dispatch
+    # pathology bench.py documents), and donated carries must be
+    # rebuilt per probe.
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, 1 << 20, size=(batch,)).astype(np.int32)
+    vals = rng.randint(0, 2**31 - 1, size=(batch, 4)).astype(np.uint32)
+    lane = np.arange(batch, dtype=np.int32)
 
-    def loop_time(body, init, reps=REPS):
-        """Median wall time per rep of body, run inside one execution."""
+    def loop_time(body, init_fn, reps=REPS):
+        """Median wall time per rep of body, run inside one execution.
+        ``init_fn`` builds a fresh carry per probe — the carry is
+        DONATED through the loop (realistic in-place updates), so it
+        must not be shared between probes."""
         fn = jax.jit(lambda c: jax.lax.fori_loop(0, reps, body, c),
                      donate_argnums=(0,))
-        c = fn(init)          # compile + first run
+        c = fn(init_fn())     # compile + first run
         sync(c)
         ts = []
         for _ in range(3):
@@ -60,9 +68,11 @@ def main():
 
     for log2cap in (21, 24, 26):
         cap = 1 << log2cap
-        table = jnp.zeros((cap, 4), jnp.uint32)
         slots = (idx * 7919) & (cap - 1)
         mb = cap * 16 / 2**20
+
+        def mk_table():
+            return (jnp.zeros((cap, 4), jnp.uint32), jnp.uint32(0))
 
         # gather rows
         def g_body(i, c):
@@ -70,29 +80,30 @@ def main():
             cur = t[(slots + i) & (cap - 1)]
             return t, acc + cur.sum(dtype=jnp.uint32)
 
-        dt, _ = loop_time(g_body, (table, jnp.uint32(0)))
+        dt, _ = loop_time(g_body, mk_table)
         say(f"cap 2^{log2cap} ({mb:5.0f}MB): gather-row   "
             f"{dt * 1e3:7.3f} ms/op")
 
         # scatter rows (set)
         def s_body(i, c):
-            t, = c
+            t, _ = c
             t = t.at[(slots + i) & (cap - 1)].set(vals, mode="drop")
-            return (t,)
+            return (t, c[1])
 
-        dt, _ = loop_time(s_body, (table,))
+        dt, _ = loop_time(s_body, mk_table)
         say(f"cap 2^{log2cap} ({mb:5.0f}MB): scatter-row  "
             f"{dt * 1e3:7.3f} ms/op")
 
         # scatter-min on int32[cap]
-        claim = jnp.full((cap,), 2**31 - 1, jnp.int32)
+        def mk_claim():
+            return (jnp.full((cap,), 2**31 - 1, jnp.int32),)
 
         def m_body(i, c):
             t, = c
             t = t.at[(slots + i) & (cap - 1)].min(lane, mode="drop")
             return (t,)
 
-        dt, _ = loop_time(m_body, (claim,))
+        dt, _ = loop_time(m_body, mk_claim)
         say(f"cap 2^{log2cap} ({mb / 4:5.0f}MB): scatter-min  "
             f"{dt * 1e3:7.3f} ms/op")
 
@@ -102,31 +113,42 @@ def main():
             t = jnp.full((cap,), 2**31 - 1, jnp.int32) + i
             return (t,)
 
-        dt, _ = loop_time(f_body, (claim,))
+        dt, _ = loop_time(f_body, mk_claim)
         say(f"cap 2^{log2cap} ({mb / 4:5.0f}MB): fill         "
             f"{dt * 1e3:7.3f} ms/op")
 
-    # sort of the batch (64-bit packed as 2x uint32 lexsort vs single)
-    k64 = vals[:, 0].astype(jnp.uint64) << 32 | vals[:, 1].astype(jnp.uint64)
+    # Batch sorts. x64 is disabled by default (uint64 silently becomes
+    # uint32), so probe what the code actually uses: a single uint32
+    # key sort, and the stable 2-word lexsort (the wide-mesh dispatch
+    # ranking and the old insert design's primitive).
+    k32 = vals[:, 0]  # numpy → HLO literal in the probe bodies
 
     def sort_body(i, c):
         k, acc = c
-        s = jnp.sort(k + i.astype(jnp.uint64))
+        s = jnp.sort(k ^ i.astype(jnp.uint32))  # noqa: E501
         return k, acc + s[0]
 
-    dt, _ = loop_time(sort_body, (k64, jnp.uint64(0)), reps=8)
-    say(f"sort u64[{batch}]: {dt * 1e3:7.3f} ms/op")
+    dt, _ = loop_time(sort_body, lambda: (jnp.asarray(k32), jnp.uint32(0)), reps=8)
+    say(f"sort u32[{batch}]: {dt * 1e3:7.3f} ms/op")
 
     def argsort_body(i, c):
         k, acc = c
-        s = jnp.argsort(k + i.astype(jnp.uint64))
+        s = jnp.argsort(k ^ i.astype(jnp.uint32))
         return k, acc + s[0]
 
-    dt, _ = loop_time(argsort_body, (k64, jnp.int32(0)), reps=8)
-    say(f"argsort u64[{batch}]: {dt * 1e3:7.3f} ms/op")
+    dt, _ = loop_time(argsort_body, lambda: (jnp.asarray(k32), jnp.int32(0)), reps=8)
+    say(f"argsort u32[{batch}]: {dt * 1e3:7.3f} ms/op")
+
+    def lexsort_body(i, c):
+        k, acc = c
+        order = jnp.lexsort((jnp.arange(batch, dtype=jnp.int32),
+                             k ^ i.astype(jnp.uint32)))
+        return k, acc + order[0]
+
+    dt, _ = loop_time(lexsort_body, lambda: (jnp.asarray(k32), jnp.int32(0)), reps=8)
+    say(f"lexsort (iota, u32)[{batch}]: {dt * 1e3:7.3f} ms/op")
 
     # gather/scatter over the BATCH (small array) for comparison
-    small = jnp.zeros((batch, 4), jnp.uint32)
     sidx = (idx * 31) & (batch - 1) if batch & (batch - 1) == 0 else idx % batch
 
     def gs_body(i, c):
@@ -134,7 +156,8 @@ def main():
         cur = t[(sidx + i) % batch]
         return t.at[(sidx + i) % batch].set(cur + 1, mode="drop"), acc
 
-    dt, _ = loop_time(gs_body, (small, jnp.uint32(0)))
+    dt, _ = loop_time(
+        gs_body, lambda: (jnp.zeros((batch, 4), jnp.uint32), jnp.uint32(0)))
     say(f"batch-sized gather+scatter [{batch},4]: {dt * 1e3:7.3f} ms/op")
 
 
