@@ -542,29 +542,73 @@ def run_e2e() -> dict:
             f"e2e dedup mismatch: drained {snap.total} != fed {total}"
         )
 
-    # Issuer-count parity vs the exact host lane on a prefix of the
-    # same stream (the reference's per-entry store semantics).
+    # Issuer-count parity on a prefix of the same stream, against BOTH
+    # reference-shaped paths:
+    #  (a) the exact host lane (per-entry parse + host dedup), and
+    #  (b) the rediscache path — BASELINE config #4's parity gate is
+    #      defined against it: DatabaseSink → FilesystemDatabase →
+    #      RESP2 RedisCache over a real TCP socket (an in-process
+    #      miniredis stands in for redis-server; RedisHost-style real
+    #      servers interchange freely, tests/test_redis_live.py).
     from ct_mapreduce_tpu.ingest.leaf import decode_entry
+    from ct_mapreduce_tpu.ingest.sync import DatabaseSink
+    from ct_mapreduce_tpu.storage.certdb import FilesystemDatabase
+    from ct_mapreduce_tpu.storage.noop import NoopBackend
+    from ct_mapreduce_tpu.storage.rediscache import RedisCache
+    from ct_mapreduce_tpu.utils.miniredis import MiniRedis
 
     host = TpuAggregator(capacity=1 << 17, batch_size=batch)
-    t0 = time.perf_counter()
-    for rb in raw_batches[:parity_batches]:
-        for li, ed in zip(rb.leaf_inputs, rb.extra_datas):
-            e = decode_entry(0, base64.b64decode(li), base64.b64decode(ed))
-            host._host_exact(
-                e.cert_der, host.registry.get_or_assign(e.issuer_der)
+    redis_server = MiniRedis().start()
+    try:
+        rcache = RedisCache(redis_server.address)
+        db = FilesystemDatabase(NoopBackend(), rcache)
+        dsink = DatabaseSink(db)
+        t0 = time.perf_counter()
+        for rb in raw_batches[:parity_batches]:
+            for j, (li, ed) in enumerate(zip(rb.leaf_inputs, rb.extra_datas)):
+                e = decode_entry(j, base64.b64decode(li),
+                                 base64.b64decode(ed))
+                host._host_exact(
+                    e.cert_der, host.registry.get_or_assign(e.issuer_der)
+                )
+                dsink.store(e, "bench-log")
+        host_snap = host.drain()
+        parity_total = parity_batches * batch
+        log(f"e2e parity: host lane {host_snap.total} vs expected "
+            f"{parity_total} ({time.perf_counter() - t0:.1f}s host+redis)")
+        if host_snap.total != parity_total:
+            raise BenchError(
+                f"e2e parity mismatch: host {host_snap.total} != "
+                f"{parity_total}"
             )
-    host_snap = host.drain()
-    parity_total = parity_batches * batch
-    log(f"e2e parity: host lane {host_snap.total} vs expected "
-        f"{parity_total} ({time.perf_counter() - t0:.1f}s host)")
-    if host_snap.total != parity_total:
-        raise BenchError(
-            f"e2e parity mismatch: host {host_snap.total} != "
-            f"{parity_total}"
-        )
-    if sorted(host_snap.issuers()) != sorted(snap.issuers()):
-        raise BenchError("e2e parity mismatch: issuer sets differ")
+        if sorted(host_snap.issuers()) != sorted(snap.issuers()):
+            raise BenchError("e2e parity mismatch: issuer sets differ")
+
+        # (b) drain the redis keyspace the way storage-statistics does
+        # (SCAN serials::* + SCARD) and demand exact per-(issuer, exp)
+        # equality with the host lane's counts on the same prefix.
+        redis_counts: dict = {}
+        for isd in db.get_issuer_and_dates_from_cache():
+            for exp in isd.exp_dates:
+                kc = db.get_known_certificates(exp, isd.issuer)
+                redis_counts[(isd.issuer.id(), exp.id())] = kc.count()
+        if redis_counts != dict(host_snap.counts):
+            host_counts = dict(host_snap.counts)
+            diff = [
+                (k, redis_counts.get(k), host_counts.get(k))
+                for k in sorted(set(redis_counts) | set(host_counts))
+                if redis_counts.get(k) != host_counts.get(k)
+            ]
+            raise BenchError(
+                "e2e rediscache-path parity mismatch on "
+                f"{len(diff)} key(s); first: {diff[0][0]} "
+                f"redis={diff[0][1]} host={diff[0][2]}"
+            )
+        log(f"e2e rediscache-path parity: {sum(redis_counts.values())} "
+            f"serials across {len(redis_counts)} (issuer, expDate) keys "
+            "match the host lane exactly")
+    finally:
+        redis_server.stop()
 
     # Per-issuer attribution: entries alternate issuers exactly, so
     # both lanes must report a perfect split (the reference's

@@ -320,12 +320,24 @@ class TpuAggregator:
         length: np.ndarray,
         issuer_idx: np.ndarray,
         valid: np.ndarray,
+        host_data: Optional[np.ndarray] = None,
     ) -> PendingIngest:
         """Dispatch the device steps for a packed batch WITHOUT reading
         anything back. Returns a :class:`PendingIngest`; until its
         ``complete()`` runs, the device computes while the host is free
-        to decode/pack the next batch (SURVEY §2.2 PP row)."""
+        to decode/pack the next batch (SURVEY §2.2 PP row).
+
+        ``data`` may be a device array whose H2D transfer the caller
+        already started (overlap with the previous step); pass the
+        NumPy rows as ``host_data`` then, so rare host-lane fallbacks
+        slice DER bytes without a per-entry D2H read."""
         n = int(data.shape[0])
+        if host_data is None:
+            host_data = data if isinstance(data, np.ndarray) else None
+        if host_data is None:
+            raise ValueError(
+                "host_data is required when data is a device array"
+            )
         res = IngestResult(
             was_unknown=np.zeros((n,), bool),
             filtered=np.zeros((n,), bool),
@@ -363,7 +375,7 @@ class TpuAggregator:
                 lane_of = None
             out = self._device_step_packed(batch)  # async dispatch
             chunks.append((batch, device_pos, lane_of, out))
-        pending = PendingIngest(self, chunks, res, data, length)
+        pending = PendingIngest(self, chunks, res, host_data, length)
         self._outstanding.append(pending)
         return pending
 

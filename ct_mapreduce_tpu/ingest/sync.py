@@ -243,10 +243,23 @@ class AggregatorSink:
             else:
                 metrics.incr_counter("ct-fetch", "parseLeafError")
 
+        # Start the H2D transfer of the big byte rows BEFORE taking the
+        # dispatch lock: device_put enqueues asynchronously, so the
+        # transfer of batch N+1 overlaps the device step of batch N
+        # (the decode half of the overlap comes from the bounded
+        # in-flight queue below). Small arrays stay host-side — the
+        # aggregator reads them for bookkeeping. Tail chunks (not a
+        # multiple of the compiled batch shape) take the NumPy path:
+        # their padding copy happens host-side in the aggregator.
+        data_host = data
+        if valid.any() and data.shape[0] % self.aggregator.batch_size == 0:
+            import jax
+
+            data = jax.device_put(data)
         with self._dispatch_lock, metrics.measure("ct-fetch", "storeCertificate"):
             if valid.any():
                 pending = self.aggregator.ingest_packed_submit(
-                    data, dec.length, issuer_idx, valid
+                    data, dec.length, issuer_idx, valid, host_data=data_host
                 )
                 self._inflight.append((
                     pending,

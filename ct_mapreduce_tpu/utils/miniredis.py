@@ -15,7 +15,7 @@ Test-support knobs the real server can't offer:
 - ``scan_duplicate=True`` replays one member per SSCAN page, modeling
   Redis's documented may-return-duplicates contract
   (/root/reference/storage/knowncertificates.go:66-68).
-- ``set_oom(True)`` makes every write command return ``-OOM ...``,
+- ``set_oom(True)`` makes every allocating write return ``-OOM ...``,
   driving the client's fatal-on-OOM path (rediscache.go:57-65 parity).
 - ``stop()``/``start()`` on the same port drives reconnect-after-kill.
 
@@ -81,8 +81,10 @@ def _array(items: list[bytes]) -> bytes:
     return b"*%d\r\n%s" % (len(items), b"".join(items))
 
 
-_WRITES = {"SADD", "SREM", "RPUSH", "LPOP", "BRPOPLPUSH", "LREM", "SET",
-           "EXPIRE", "EXPIREAT", "DEL"}
+# Commands denied under OOM: real Redis only rejects commands flagged
+# may-use-memory; memory-FREEING commands (DEL, SREM, LPOP, LREM,
+# EXPIRE...) always succeed so clients can dig themselves out.
+_OOM_DENIED = {"SADD", "RPUSH", "SET", "BRPOPLPUSH"}
 
 
 class MiniRedis:
@@ -218,7 +220,7 @@ class MiniRedis:
 
     def _dispatch(self, args: list[str]) -> bytes:
         cmd = args[0].upper()
-        if self._oom and cmd in _WRITES:
+        if self._oom and cmd in _OOM_DENIED:
             return (b"-OOM command not allowed when used memory > "
                     b"'maxmemory'.\r\n")
         with self._lock:

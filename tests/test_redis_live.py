@@ -8,7 +8,7 @@ and leader election under contention, all through the hand-rolled RESP2
 client (storage/rediscache.py) over a real TCP socket.
 
 The reference skips this tier unless a server is reachable; here it
-runs BY DEFAULT against :mod:`tests.miniredis` (an in-process RESP2
+runs BY DEFAULT against :mod:`ct_mapreduce_tpu.utils.miniredis` (an in-process RESP2
 server with real Redis semantics), because this image cannot run
 redis-server. Set ``RedisHost=<ip:port>`` to point the same tests at a
 genuine server instead (``docker run -p 6379:6379 redis`` →
@@ -25,7 +25,7 @@ from datetime import datetime, timedelta, timezone
 
 import pytest
 
-from tests.miniredis import MiniRedis
+from ct_mapreduce_tpu.utils.miniredis import MiniRedis
 
 
 @pytest.fixture(scope="module")

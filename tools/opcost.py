@@ -30,6 +30,11 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    # Load-bearing despite looking redundant: the ambient axon
+    # sitecustomize imports jax at interpreter start, after which the
+    # env var alone no longer selects the platform (verified: without
+    # this, JAX_PLATFORMS=cpu still initialized the axon backend).
+    # Same workaround as tests/conftest.py and bench.py.
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         jax.config.update("jax_platforms", "cpu")
 
