@@ -32,12 +32,13 @@ not an occupied mismatch:
   wins and writes key+meta (winners hold unique slots, so those
   scatters never see duplicate indices — XLA's duplicate-index
   scatter is specified per element, not per row, so a whole-row CAS
-  could tear). Losers resolve IN the same round by re-reading the
-  contested slot after the winner's write: the winner's key matching
-  theirs means a within-batch duplicate (done, ``was_unknown=False``
-  — first-in-lane-order wins, exactly Redis SADD semantics when the
-  reference stores the same serial twice); a different key means the
-  chain moved — probe on past the slot;
+  could tear). Losers resolve IN the same round by comparing their key
+  against the winner's — ``keys[claim[slot]]``, a batch-sized gather,
+  never a second table-sized read: a match means a within-batch
+  duplicate (done, ``was_unknown=False`` — first-in-lane-order wins,
+  exactly Redis SADD semantics when the reference stores the same
+  serial twice); a different key means the chain moved — probe on past
+  the slot;
 - all window positions occupied by other keys → ``r`` advances past
   the window.
 
@@ -69,6 +70,7 @@ device pass.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -76,7 +78,12 @@ import jax.numpy as jnp
 import numpy as np
 
 
-PROBE_WIDTH = 4  # chain positions examined per probe round (one gather)
+# Chain positions examined per probe round (one gather). Wider windows
+# resolve more lanes in round 1 (P(all W occupied) = load^W) at the
+# price of a W-times-larger gather; env-tunable for hardware sweeps.
+PROBE_WIDTH = int(os.environ.get("CTMR_PROBE_WIDTH", "4"))
+if PROBE_WIDTH < 1:
+    raise ValueError(f"CTMR_PROBE_WIDTH must be >= 1, got {PROBE_WIDTH}")
 
 
 class TableState(NamedTuple):
@@ -176,19 +183,21 @@ def insert(
         # occupies it, so no later round can see it empty again.
         cslot = jnp.where(empty, slot, capacity)  # OOB rows are dropped
         claim = claim.at[cslot].min(lane, mode="drop")
-        winner = empty & (claim[slot] == lane)
+        wlane = claim[slot]  # winning lane id at each contested slot
+        winner = empty & (wlane == lane)
         # Winners hold unique slots: key/meta scatters see no duplicates.
         wslot = jnp.where(winner, slot, capacity)
         table_keys = table_keys.at[wslot].set(keys, mode="drop")
         table_meta = table_meta.at[wslot].set(meta, mode="drop")
         # Resolve election losers IN-ROUND (random-access ops have a
-        # large fixed cost on TPU, so an extra gather here is far
-        # cheaper than an extra round): re-read the contested slot —
-        # losers whose key now sits there are within-batch duplicates
+        # large fixed cost on TPU, so resolving here is far cheaper
+        # than an extra round): the winner's key is keys[wlane] — a
+        # BATCH-sized gather, never a second table-sized one. Losers
+        # whose key equals the winner's are within-batch duplicates
         # (done, known); distinct-key losers probe on past the slot.
-        cur2 = table_keys[slot]  # [B, 4]
+        wkeys = jnp.take(keys, jnp.clip(wlane, 0, b - 1), axis=0)  # [B, 4]
         loser = empty & ~winner
-        loser_match = loser & jnp.all(cur2 == keys, axis=-1)
+        loser_match = loser & jnp.all(wkeys == keys, axis=-1)
         found = found | match | loser_match
         inserted = inserted | winner
         pending = pending & ~match & ~winner & ~loser_match
