@@ -59,20 +59,87 @@ def decode_raw_batch(
     leaf_inputs: Sequence[str],
     extra_datas: Sequence[str],
     pad_len: int,
+    workers: Optional[int] = None,
 ) -> DecodedBatch:
+    """Decode one get-entries response into packed device arrays.
+
+    ``workers`` > 1 splits the batch across a thread pool — the ctypes
+    call releases the GIL, so on multi-core TPU hosts decode scales
+    with cores (it is the e2e ingest bottleneck at ~200k entries/s per
+    core; a 10M entries/s chip needs tens of decode cores feeding it).
+    Default: ``CTMR_DECODE_WORKERS`` env, else ``os.cpu_count()``,
+    bounded so each chunk keeps >= 2048 entries.
+    """
+    import os
+
     n = len(leaf_inputs)
     lib = load_native()
     if lib is None:
         return _decode_python(leaf_inputs, extra_datas, pad_len)
 
-    li_buf, li_off = _concat_b64(leaf_inputs)
-    ed_buf, ed_off = _concat_b64(extra_datas)
+    if workers is None:
+        workers = int(os.environ.get("CTMR_DECODE_WORKERS", "0")) or (
+            os.cpu_count() or 1
+        )
+        # Auto-sizing keeps >= 2048 entries per chunk; an explicit
+        # ``workers`` argument is honored as given (tests exercise the
+        # threaded path on small batches).
+        workers = max(1, min(workers, n // 2048)) if n >= 4096 else 1
+    workers = max(1, min(workers, n)) if n else 1
 
     data = np.zeros((n, pad_len), np.uint8)
     length = np.zeros((n,), np.int32)
     ts = np.zeros((n,), np.int64)
     ety = np.zeros((n,), np.int32)
     status = np.zeros((n,), np.int32)
+    out = (data, length, ts, ety, status)
+
+    if workers > 1:
+        # Chunks write into disjoint row ranges of the preallocated
+        # outputs (contiguous views — no post-hoc concatenate, no 2x
+        # peak memory); the ctypes call drops the GIL, so chunks run
+        # in parallel on multi-core hosts.
+        from concurrent.futures import ThreadPoolExecutor
+
+        bounds = [(k * n) // workers for k in range(workers + 1)]
+        ranges = [(bounds[k], bounds[k + 1]) for k in range(workers)
+                  if bounds[k + 1] > bounds[k]]
+
+        def run(lo: int, hi: int) -> list:
+            return _decode_native_into(
+                lib, leaf_inputs[lo:hi], extra_datas[lo:hi], pad_len,
+                tuple(a[lo:hi] for a in out),
+            )
+
+        with ThreadPoolExecutor(max_workers=len(ranges)) as pool:
+            chunk_issuers = list(pool.map(lambda r: run(*r), ranges))
+        issuers: list[Optional[bytes]] = []
+        for ci in chunk_issuers:
+            if ci is None:  # native scratch overflow in one chunk
+                return _decode_python(leaf_inputs, extra_datas, pad_len)
+            issuers.extend(ci)
+        return DecodedBatch(data, length, ts, ety, issuers, status)
+
+    issuers = _decode_native_into(lib, leaf_inputs, extra_datas, pad_len, out)
+    if issuers is None:  # issuer scratch overflow — impossible by sizing
+        return _decode_python(leaf_inputs, extra_datas, pad_len)
+    return DecodedBatch(data, length, ts, ety, issuers, status)
+
+
+def _decode_native_into(
+    lib,
+    leaf_inputs: Sequence[str],
+    extra_datas: Sequence[str],
+    pad_len: int,
+    out: tuple,
+) -> Optional[list]:
+    """Run the native decoder writing into caller-provided row views
+    ``out = (data, length, ts, ety, status)``; returns the per-entry
+    issuer DER list, or None on native scratch overflow."""
+    n = len(leaf_inputs)
+    data, length, ts, ety, status = out
+    li_buf, li_off = _concat_b64(leaf_inputs)
+    ed_buf, ed_off = _concat_b64(extra_datas)
     issuer_off = np.zeros((n,), np.int64)
     issuer_len = np.zeros((n,), np.int32)
     # Issuer chain certs are ~1-2 KB; extra_data is an upper bound.
@@ -99,16 +166,15 @@ def decode_raw_batch(
         status.ctypes.data_as(i32p),
         scratch.ctypes.data_as(u8p), scratch.shape[0],
     )
-    if used < 0:  # issuer scratch overflow — impossible by sizing, but safe
-        return _decode_python(leaf_inputs, extra_datas, pad_len)
+    if used < 0:
+        return None
 
     issuer_bytes = issuer_buf.tobytes()
-    issuers: list[Optional[bytes]] = [
+    return [
         issuer_bytes[issuer_off[i] : issuer_off[i] + issuer_len[i]]
         if issuer_len[i] > 0 else None
         for i in range(n)
     ]
-    return DecodedBatch(data, length, ts, ety, issuers, status)
 
 
 def _decode_python(

@@ -171,3 +171,19 @@ def test_native_throughput_sanity():
     np.testing.assert_array_equal(nat.data, py.data)
     assert t_native < t_py, (t_native, t_py)
     print(f"native {2100/t_native:,.0f}/s vs python {2100/t_py:,.0f}/s")
+
+
+def test_decode_threaded_matches_single():
+    """The thread-pool split (multi-core host path) must stitch results
+    identical to the single-shot decode, including entry order, issuer
+    bytes and status codes (mixed valid/garbage/no-chain wire)."""
+    lis, eds, _expect, _issuer = _wire_batch()
+
+    single = leafpack.decode_raw_batch(lis, eds, 2048, workers=1)
+    multi = leafpack.decode_raw_batch(lis, eds, 2048, workers=3)
+    np.testing.assert_array_equal(single.data, multi.data)
+    np.testing.assert_array_equal(single.length, multi.length)
+    np.testing.assert_array_equal(single.timestamp_ms, multi.timestamp_ms)
+    np.testing.assert_array_equal(single.entry_type, multi.entry_type)
+    np.testing.assert_array_equal(single.status, multi.status)
+    assert single.issuers == multi.issuers
