@@ -82,6 +82,16 @@ struct Reader {
   }
 };
 
+// FNV-1a 64-bit over a byte span (issuer-dedup hash).
+uint64_t fnv1a(const uint8_t* p, int64_t n) {
+  uint64_t h = 1469598103934665603ull;
+  for (int64_t i = 0; i < n; ++i) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
 }  // namespace
 
 extern "C" {
@@ -122,6 +132,15 @@ int64_t ctmr_decode_entries(
     int32_t* status,
     uint8_t* scratch, int64_t scratch_cap) {
   int64_t issuer_used = 0;
+  // Issuer dedup: CT batches carry a handful of distinct issuers, so
+  // identical chain[0] DERs share one span of issuer_buf (callers
+  // group entries by (off, len) without re-hashing bytes in Python).
+  // Fixed-size open-addressed table; on overflow we just append —
+  // correctness never depends on a dedup hit.
+  constexpr int kIssSlots = 512;  // power of two
+  struct IssSlot { uint64_t h; int64_t off; int32_t len; };
+  IssSlot iss_tab[kIssSlots];
+  std::memset(iss_tab, 0, sizeof(iss_tab));
   for (int64_t i = 0; i < n; ++i) {
     status[i] = CTMR_OK;
     length[i] = 0;
@@ -226,11 +245,43 @@ int64_t ctmr_decode_entries(
       status[i] = CTMR_NO_CHAIN;  // cert still packed; caller decides
       continue;
     }
+    if (chain_issuer_len >= (1 << 21)) {
+      // Pathological >=2 MiB issuer DER: the Python span packing
+      // (off*2^21 + len) requires len < 2^21, so route the entry down
+      // the exact per-entry host lane instead of risking aliasing.
+      status[i] = CTMR_TOO_LONG;
+      continue;
+    }
+    const uint8_t* iss_src = ed_scratch + chain_issuer_off;
+    uint64_t h = fnv1a(iss_src, chain_issuer_len);
+    if (h == 0) h = 1;  // 0 marks an empty slot
+    int64_t found_off = -1;
+    int probe = (int)(h & (kIssSlots - 1));
+    int tries = 0;
+    for (; tries < kIssSlots; ++tries) {
+      IssSlot& s = iss_tab[probe];
+      if (s.h == 0) break;  // miss — insert here after the append
+      if (s.h == h && s.len == (int32_t)chain_issuer_len &&
+          std::memcmp(issuer_buf + s.off, iss_src,
+                      (size_t)chain_issuer_len) == 0) {
+        found_off = s.off;
+        break;
+      }
+      probe = (probe + 1) & (kIssSlots - 1);
+    }
+    if (found_off >= 0) {
+      issuer_off[i] = found_off;
+      issuer_len[i] = (int32_t)chain_issuer_len;
+      continue;
+    }
     if (issuer_used + chain_issuer_len > issuer_cap) return -1;
-    std::memcpy(issuer_buf + issuer_used, ed_scratch + chain_issuer_off,
+    std::memcpy(issuer_buf + issuer_used, iss_src,
                 (size_t)chain_issuer_len);
     issuer_off[i] = issuer_used;
     issuer_len[i] = (int32_t)chain_issuer_len;
+    if (tries < kIssSlots && iss_tab[probe].h == 0) {
+      iss_tab[probe] = {h, issuer_used, (int32_t)chain_issuer_len};
+    }
     issuer_used += chain_issuer_len;
   }
   return issuer_used;

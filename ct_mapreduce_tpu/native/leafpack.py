@@ -36,11 +36,39 @@ class DecodedBatch:
     length: np.ndarray  # int32[n]
     timestamp_ms: np.ndarray  # int64[n]
     entry_type: np.ndarray  # int32[n]
-    issuers: list[Optional[bytes]]  # chain[0] DER per entry
+    _issuers: Optional[list]  # chain[0] DER per entry; None = lazy
     status: np.ndarray  # int32[n]
+    # Issuer grouping (vectorized sink bookkeeping): entries with the
+    # same chain[0] DER share a group id; group_issuers[g] is that DER.
+    # -1 = no issuer. None when the producer didn't compute groups.
+    issuer_group: Optional[np.ndarray] = None  # int32[n]
+    group_issuers: Optional[list] = None  # list[bytes]
+
+    @property
+    def issuers(self) -> list:
+        """Per-entry issuer DER list (duplicates share one bytes
+        object). Materialized lazily — the vectorized sink path works
+        from ``issuer_group``/``group_issuers`` and never pays the
+        per-entry list build."""
+        if self._issuers is None:
+            self._issuers = [
+                self.group_issuers[g] if g >= 0 else None
+                for g in self.issuer_group.tolist()
+            ]
+        return self._issuers
 
     def ok_mask(self) -> np.ndarray:
         return self.status == OK
+
+
+def _assign_gid(gid_of: dict, group_issuers: list, der: bytes) -> int:
+    """Accumulating DER→group-id assignment (shared by every producer
+    that merges issuer groups)."""
+    gid = gid_of.get(der)
+    if gid is None:
+        gid = gid_of[der] = len(group_issuers)
+        group_issuers.append(der)
+    return gid
 
 
 def _concat_b64(strings: Sequence[str]) -> tuple[bytes, np.ndarray]:
@@ -105,25 +133,65 @@ def decode_raw_batch(
         ranges = [(bounds[k], bounds[k + 1]) for k in range(workers)
                   if bounds[k + 1] > bounds[k]]
 
-        def run(lo: int, hi: int) -> list:
+        def run(lo: int, hi: int):
             return _decode_native_into(
                 lib, leaf_inputs[lo:hi], extra_datas[lo:hi], pad_len,
                 tuple(a[lo:hi] for a in out),
             )
 
         with ThreadPoolExecutor(max_workers=len(ranges)) as pool:
-            chunk_issuers = list(pool.map(lambda r: run(*r), ranges))
-        issuers: list[Optional[bytes]] = []
-        for ci in chunk_issuers:
-            if ci is None:  # native scratch overflow in one chunk
-                return _decode_python(leaf_inputs, extra_datas, pad_len)
-            issuers.extend(ci)
-        return DecodedBatch(data, length, ts, ety, issuers, status)
+            spans = list(pool.map(lambda r: run(*r), ranges))
+        if any(s is None for s in spans):  # native scratch overflow
+            return _decode_python(leaf_inputs, extra_datas, pad_len)
+        # Merge per-chunk issuer groups by DER bytes (a handful per
+        # chunk — per-group work, never per-entry).
+        group = np.full((n,), -1, np.int32)
+        group_issuers: list = []
+        gid_of: dict = {}
+        for (lo, hi), span in zip(ranges, spans):
+            c_group, c_issuers = _issuer_groups(hi - lo, *span)
+            remap = np.full((len(c_issuers) + 1,), -1, np.int32)
+            for g, der in enumerate(c_issuers):
+                remap[g] = _assign_gid(gid_of, group_issuers, der)
+            group[lo:hi] = remap[c_group]
+        return DecodedBatch(data, length, ts, ety, None, status,
+                            issuer_group=group, group_issuers=group_issuers)
 
-    issuers = _decode_native_into(lib, leaf_inputs, extra_datas, pad_len, out)
-    if issuers is None:  # issuer scratch overflow — impossible by sizing
+    span = _decode_native_into(lib, leaf_inputs, extra_datas, pad_len, out)
+    if span is None:  # issuer scratch overflow — impossible by sizing
         return _decode_python(leaf_inputs, extra_datas, pad_len)
-    return DecodedBatch(data, length, ts, ety, issuers, status)
+    group, group_issuers = _issuer_groups(n, *span)
+    return DecodedBatch(data, length, ts, ety, None, status,
+                        issuer_group=group, group_issuers=group_issuers)
+
+
+def _issuer_groups(
+    n: int,
+    issuer_off: np.ndarray,
+    issuer_len: np.ndarray,
+    issuer_buf: np.ndarray,
+) -> tuple:
+    """Vectorized grouping of entries by issuer span.
+
+    The native decoder dedups identical issuer DERs into shared
+    (off, len) spans, so grouping is a numpy unique over the span ids
+    — no per-entry byte hashing in Python."""
+    has = issuer_len > 0
+    # off < issuer_cap (< 2^42), len < 2^21 (pad-scale certs): the
+    # combined key fits int64 losslessly.
+    combo = issuer_off * (1 << 21) + issuer_len
+    group = np.full((n,), -1, np.int32)
+    if not has.any():
+        return group, []
+    uniq, inverse = np.unique(combo[has], return_inverse=True)
+    group[has] = inverse.astype(np.int32)
+    buf = issuer_buf.tobytes()
+    group_issuers = [
+        buf[int(c) >> 21 : (int(c) >> 21) + (int(c) & ((1 << 21) - 1))]
+        for c in uniq
+    ]
+    return group, group_issuers
+
 
 
 def _decode_native_into(
@@ -132,10 +200,11 @@ def _decode_native_into(
     extra_datas: Sequence[str],
     pad_len: int,
     out: tuple,
-) -> Optional[list]:
+) -> Optional[tuple]:
     """Run the native decoder writing into caller-provided row views
-    ``out = (data, length, ts, ety, status)``; returns the per-entry
-    issuer DER list, or None on native scratch overflow."""
+    ``out = (data, length, ts, ety, status)``; returns the issuer span
+    arrays ``(issuer_off, issuer_len, issuer_buf)`` (identical DERs
+    share one span), or None on native scratch overflow."""
     n = len(leaf_inputs)
     data, length, ts, ety, status = out
     li_buf, li_off = _concat_b64(leaf_inputs)
@@ -168,13 +237,7 @@ def _decode_native_into(
     )
     if used < 0:
         return None
-
-    issuer_bytes = issuer_buf.tobytes()
-    return [
-        issuer_bytes[issuer_off[i] : issuer_off[i] + issuer_len[i]]
-        if issuer_len[i] > 0 else None
-        for i in range(n)
-    ]
+    return issuer_off, issuer_len, issuer_buf[:used]
 
 
 def _decode_python(
@@ -219,4 +282,13 @@ def _decode_python(
             status[i] = NO_CHAIN
         else:
             issuers[i] = e.issuer_der
-    return DecodedBatch(data, length, ts, ety, issuers, status)
+    # Grouping for the vectorized sink path (dict-based — this is the
+    # no-native fallback, already per-entry Python).
+    group = np.full((n,), -1, np.int32)
+    group_issuers: list = []
+    gid_of: dict = {}
+    for i, der in enumerate(issuers):
+        if der is not None:
+            group[i] = _assign_gid(gid_of, group_issuers, der)
+    return DecodedBatch(data, length, ts, ety, issuers, status,
+                        issuer_group=group, group_issuers=group_issuers)

@@ -1,0 +1,89 @@
+"""Probe-width x batch-width sweep of the dedup insert on real TPU.
+
+For each (PROBE_WIDTH, batch) combination this re-execs itself so the
+width (a module-load-time constant) recompiles cleanly, then times
+all-fresh inserts exactly like tools/microbench.py. Run with no args
+to sweep; results print as one line per combo.
+
+    python tools/insert_sweep.py            # full sweep
+    CTMR_PROBE_WIDTH=8 python tools/insert_sweep.py 1048576 --one
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+WIDTHS = (2, 4, 8, 16)
+BATCHES = (131072, 1048576)
+
+
+def say(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_one(batch: int) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    # Same platform workaround as bench.py (the ambient sitecustomize
+    # imports jax before the env var can take effect).
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from ct_mapreduce_tpu.ops import hashtable
+
+    cap = 1 << int(os.environ.get("CT_IS_LOG2_CAP", "26"))
+    dev = jax.devices()[0]
+    sync = jax.block_until_ready
+    rng = np.random.RandomState(7)
+    fps = rng.randint(0, 2**31, size=(batch, 4)).astype(np.uint32)
+    f = sync(jax.device_put(fps))
+    meta = jnp.zeros((batch,), jnp.uint32)
+    valid = sync(jax.device_put(np.ones((batch,), bool)))
+
+    ins = jax.jit(hashtable.insert, donate_argnums=(0,))
+    stamp = jax.jit(lambda f, e: f.at[:, 3].set(
+        f[:, 3] ^ (e.astype(jnp.uint32) << 8)))
+    tbl = hashtable.make_table(cap)
+    t0 = time.perf_counter()
+    tbl, wu, ovf = ins(tbl, stamp(f, jnp.uint32(0)), meta, valid)
+    sync(wu)
+    compile_s = time.perf_counter() - t0
+    ts = []
+    for e in range(1, 5):
+        k = sync(stamp(f, jnp.uint32(e)))
+        t0 = time.perf_counter()
+        tbl, wu, ovf = ins(tbl, k, meta, valid)
+        n_new = int(wu.sum())
+        ts.append(time.perf_counter() - t0)
+    dt = float(np.median(ts))
+    say(f"W={hashtable.PROBE_WIDTH:2d} batch={batch:8d} "
+        f"cap=2^{cap.bit_length() - 1} [{dev.device_kind}]: "
+        f"{dt * 1e3:8.2f} ms  {batch / dt / 1e6:6.2f} M/s "
+        f"(compile {compile_s:.0f}s, last fresh={n_new})")
+
+
+def main() -> None:
+    if "--one" in sys.argv:
+        batch = int(sys.argv[1])
+        run_one(batch)
+        return
+    for width in WIDTHS:
+        for batch in BATCHES:
+            env = dict(os.environ, CTMR_PROBE_WIDTH=str(width))
+            subprocess.run(
+                [sys.executable, os.path.abspath(__file__), str(batch),
+                 "--one"],
+                env=env, check=False, timeout=600,
+            )
+
+
+if __name__ == "__main__":
+    main()
