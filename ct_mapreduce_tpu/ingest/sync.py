@@ -201,65 +201,40 @@ class AggregatorSink:
 
         n = len(pairs)
         issuer_idx = np.zeros((n,), np.int32)
-        valid = np.zeros((n,), bool)
         oversized: list[tuple[bytes, bytes]] = []
-        if dec.issuer_group is not None:
-            # Vectorized bookkeeping: per-GROUP registry work (a
-            # handful of distinct issuers per batch), numpy for the
-            # per-entry mapping — no 64K-iteration Python loop.
-            gmap = np.full((len(dec.group_issuers) + 1,), -1, np.int32)
-            for g, der in enumerate(dec.group_issuers):
-                try:
-                    gmap[g] = self.aggregator.registry.get_or_assign(der)
-                except Exception:
-                    # Malformed issuer DER costs its entries, not the
-                    # whole chunk (per-entry path parity).
-                    gmap[g] = -1
-            ok = dec.status == leafpack.OK
-            grp = dec.issuer_group
-            mapped = gmap[grp]  # grp -1 → last slot (-1 sentinel)
-            valid = ok & (mapped >= 0)
-            issuer_idx[valid] = mapped[valid]
-            bad_issuer = int((ok & (mapped < 0)).sum())
-            no_chain = int((dec.status == leafpack.NO_CHAIN).sum())
-            too_long = np.nonzero(dec.status == leafpack.TOO_LONG)[0]
-            other_bad = int(
-                ((dec.status != leafpack.OK)
-                 & (dec.status != leafpack.NO_CHAIN)
-                 & (dec.status != leafpack.TOO_LONG)).sum()
-            )
-            if bad_issuer or other_bad:
-                metrics.incr_counter("ct-fetch", "parseLeafError",
-                                     value=float(bad_issuer + other_bad))
-            if no_chain:
-                metrics.incr_counter("ct-fetch", "noChainError",
-                                     value=float(no_chain))
-        else:
-            # No grouping info (unexpected producer): per-entry loop.
-            idx_cache: dict[bytes, int] = {}
-            too_long = []
-            for i in range(n):
-                st = int(dec.status[i])
-                if st == leafpack.OK:
-                    iss = dec.issuers[i]
-                    idx = idx_cache.get(iss)
-                    if idx is None:
-                        try:
-                            idx = self.aggregator.registry.get_or_assign(iss)
-                        except Exception:
-                            idx = -1
-                        idx_cache[iss] = idx
-                    if idx < 0:
-                        metrics.incr_counter("ct-fetch", "parseLeafError")
-                        continue
-                    issuer_idx[i] = idx
-                    valid[i] = True
-                elif st == leafpack.NO_CHAIN:
-                    metrics.incr_counter("ct-fetch", "noChainError")
-                elif st == leafpack.TOO_LONG:
-                    too_long.append(i)
-                else:
-                    metrics.incr_counter("ct-fetch", "parseLeafError")
+        # Every DecodedBatch producer computes issuer groups
+        # (leafpack.decode_raw_batch native/threaded/python paths).
+        assert dec.issuer_group is not None, "producer without groups"
+        # Vectorized bookkeeping: per-GROUP registry work (a handful of
+        # distinct issuers per batch), numpy for the per-entry mapping
+        # — no 64K-iteration Python loop.
+        gmap = np.full((len(dec.group_issuers) + 1,), -1, np.int32)
+        for g, der in enumerate(dec.group_issuers):
+            try:
+                gmap[g] = self.aggregator.registry.get_or_assign(der)
+            except Exception:
+                # Malformed issuer DER costs its entries, not the
+                # whole chunk (per-entry path parity).
+                gmap[g] = -1
+        ok = dec.status == leafpack.OK
+        grp = dec.issuer_group
+        mapped = gmap[grp]  # grp -1 → last slot (-1 sentinel)
+        valid = ok & (mapped >= 0)
+        issuer_idx[valid] = mapped[valid]
+        bad_issuer = int((ok & (mapped < 0)).sum())
+        no_chain = int((dec.status == leafpack.NO_CHAIN).sum())
+        too_long = np.nonzero(dec.status == leafpack.TOO_LONG)[0]
+        other_bad = int(
+            ((dec.status != leafpack.OK)
+             & (dec.status != leafpack.NO_CHAIN)
+             & (dec.status != leafpack.TOO_LONG)).sum()
+        )
+        if bad_issuer or other_bad:
+            metrics.incr_counter("ct-fetch", "parseLeafError",
+                                 value=float(bad_issuer + other_bad))
+        if no_chain:
+            metrics.incr_counter("ct-fetch", "noChainError",
+                                 value=float(no_chain))
         for i in too_long:
             # Rare oversized cert: exact per-entry lane.
             try:

@@ -103,7 +103,8 @@ enum {
   CTMR_BAD_LEAF = 2,
   CTMR_UNSUPPORTED = 3,   // version/leaf_type/entry_type unknown
   CTMR_NO_CHAIN = 4,      // no issuer certificate in extra_data
-  CTMR_TOO_LONG = 5,      // cert exceeds pad_len (host lane)
+  CTMR_TOO_LONG = 5,      // cert exceeds pad_len, or issuer DER >=
+                          // 2 MiB (either way: exact host lane)
 };
 
 // Decode one get-entries batch and pack leaf certificates.

@@ -280,6 +280,12 @@ def _decode_python(
         length[i] = len(e.cert_der)
         if not e.issuer_der:  # absent OR zero-length chain[0]
             status[i] = NO_CHAIN
+        elif len(e.issuer_der) >= (1 << 21):
+            # Native-path parity: pathological >=2 MiB issuer DERs are
+            # routed down the exact host lane (span-packing bound).
+            data[i, :] = 0
+            length[i] = 0
+            status[i] = TOO_LONG
         else:
             issuers[i] = e.issuer_der
     # Grouping for the vectorized sink path (dict-based — this is the
