@@ -282,9 +282,9 @@ def _decode_python(
             status[i] = NO_CHAIN
         elif len(e.issuer_der) >= (1 << 21):
             # Native-path parity: pathological >=2 MiB issuer DERs are
-            # routed down the exact host lane (span-packing bound).
-            data[i, :] = 0
-            length[i] = 0
+            # routed down the exact host lane (span-packing bound). The
+            # cert row stays packed, exactly like the native decoder
+            # (which packs before its issuer-length check).
             status[i] = TOO_LONG
         else:
             issuers[i] = e.issuer_der

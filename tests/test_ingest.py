@@ -506,3 +506,49 @@ def test_cursor_saved_on_download_error():
         w.run(q, threading.Event(), save_period_s=1e9)
     st = db.get_log_state("ct.example.com/fake")
     assert st.max_entry == 2  # first batch durable, not lost
+
+
+def test_sync_multi_log_shared_sink():
+    """BASELINE config #5's shape: several logs, one downloader thread
+    each (the reference's per-log goroutines, ct-fetch.go:527-565),
+    all feeding ONE shared aggregator. Dedup spans logs (the identity
+    is (expDate, issuer, serial), not the log), and each log keeps an
+    independent resumable cursor."""
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+
+    issuer_der = certgen.make_cert(serial=1, issuer_cn="Multi CA",
+                                   is_ca=True, not_after=FUTURE)
+
+    def leaf(s):
+        return certgen.make_cert(
+            serial=s, issuer_cn="Multi CA", subject_cn="m.example.com",
+            is_ca=False, not_after=FUTURE,
+        )
+
+    log_a = FakeLog(url="https://ct.example.com/a")
+    log_b = FakeLog(url="https://ct.example.com/b")
+    for s in (700, 701, 702):
+        log_a.add_cert(leaf(s), issuer_der)
+    # b overlaps a on 701/702 — cross-log duplicates must dedup.
+    for s in (701, 702, 703, 704):
+        log_b.add_cert(leaf(s), issuer_der)
+
+    agg = TpuAggregator(
+        capacity=1 << 12, batch_size=64,
+        now=datetime.datetime(2025, 1, 1, tzinfo=UTC),
+    )
+    db = _db()
+    sink = AggregatorSink(agg, flush_size=3)
+    engine = LogSyncEngine(sink, db, num_threads=2)
+    engine.start_store_threads()
+    engine.sync_log(log_a.url, transport=log_a.transport)
+    engine.sync_log(log_b.url, transport=log_b.transport)
+    engine.wait_for_downloads(timeout=60)
+    engine.stop()
+
+    snap = agg.drain()
+    assert snap.total == 5  # 700..704 exactly once across both logs
+    assert sink.entries_in == 7
+    # Independent per-log cursors at each tree size.
+    assert db.get_log_state("ct.example.com/a").max_entry == 3
+    assert db.get_log_state("ct.example.com/b").max_entry == 4
