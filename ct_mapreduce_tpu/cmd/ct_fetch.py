@@ -83,7 +83,8 @@ def build_sink(config: CTConfig, database, backend=None):
         return AggregatorSink(model.aggregator,
                               flush_size=config.batch_size,
                               backend=pem_backend,
-                              device_queue_depth=config.device_queue_depth), model
+                              device_queue_depth=config.device_queue_depth,
+                              decode_workers=config.decode_workers), model
     sink = DatabaseSink(
         database,
         cn_filters=tuple(config.issuer_cn_filters()),

@@ -30,6 +30,7 @@ class CTConfig:
     limit: int = 0
     log_url_list: str = ""  # "logList"
     num_threads: int = 1
+    decode_workers: int = 0  # 0 = auto (cpu count); raw-batch decode pool
     log_expired_entries: bool = False
     run_forever: bool = False
     polling_delay_mean: str = "10m"
@@ -62,6 +63,7 @@ class CTConfig:
         "limit": ("limit", int),
         "logList": ("log_url_list", str),
         "numThreads": ("num_threads", int),
+        "decodeWorkers": ("decode_workers", int),
         "logExpiredEntries": ("log_expired_entries", bool),
         "runForever": ("run_forever", bool),
         "pollingDelayMean": ("polling_delay_mean", str),
@@ -209,6 +211,7 @@ class CTConfig:
             "pollingDelayStdDev = Use this standard deviation between polls",
             "logExpiredEntries = Add expired entries to the database",
             "numThreads = Use this many threads for normal operations",
+            "decodeWorkers = native leaf-decode threads (0 = cpu count)",
             "savePeriod = Duration between state saves, e.g. 15m",
             "logList = URLs of the CT Logs, comma delimited",
             "outputRefreshPeriod = Period between output publications",

@@ -129,7 +129,7 @@ class AggregatorSink:
     # above it take the exact host lane, like oversized serials)
 
     def __init__(self, aggregator, flush_size: int = 4096, backend=None,
-                 device_queue_depth: int = 2):
+                 device_queue_depth: int = 2, decode_workers: int = 0):
         self.aggregator = aggregator
         self.flush_size = flush_size
         # Optional durable backend (certPath): first-seen certs get the
@@ -149,6 +149,8 @@ class AggregatorSink:
         # batch N+1 overlaps the device step of batch N. Depth 0 =
         # fully synchronous (reference-exact store ordering).
         self.device_queue_depth = max(0, int(device_queue_depth))
+        # 0 = leafpack auto-sizing (CTMR_DECODE_WORKERS / cpu count).
+        self.decode_workers = int(decode_workers) or None
         self._inflight: deque = deque()  # (PendingIngest, der_of)
         # Without a PEM backend the per-entry serial bytes are only
         # needed for the cross-encoding guard; let the aggregator skip
@@ -189,7 +191,9 @@ class AggregatorSink:
         lis = [p[0] for p in pairs]
         eds = [p[1] for p in pairs]
         with metrics.measure("ct-fetch", "decodeBatch"):
-            dec = leafpack.decode_raw_batch(lis, eds, self.PAD_LEN)
+            dec = leafpack.decode_raw_batch(
+                lis, eds, self.PAD_LEN, workers=self.decode_workers
+            )
         # Row-width bucketing: when every cert in the batch fits half
         # the pad, ship the narrow view — H2D bytes halve (the
         # dominant cost on tunneled links), at the price of one extra
