@@ -44,7 +44,29 @@ def _patch_transport(monkeypatch, log):
     monkeypatch.setattr(ctclient, "_urllib_transport", log.transport)
 
 
-def test_ct_fetch_tpu_backend_and_statistics(tmp_path, monkeypatch, capsys):
+@pytest.mark.parametrize("mesh_shape,expect_sharded", [
+    ("shard:1", False),  # explicit single chip -> TpuAggregator
+    ("", True),          # default: all 8 virtual devices, sharded
+    ("shard:8", True),   # explicit mesh (BASELINE config #5's shape)
+])
+def test_ct_fetch_tpu_backend_and_statistics(tmp_path, monkeypatch, capsys,
+                                             mesh_shape, expect_sharded):
+    """TPU-backend CLI flow across aggregator selections: ct-fetch
+    ingests through the device pipeline (single-chip or all_to_all
+    mesh-sharded per meshShape), snapshots, and storage-statistics
+    drains the snapshot identically in every case."""
+    from ct_mapreduce_tpu.agg import sharded_agg
+
+    sharded_built = []
+    orig_sharded = sharded_agg.ShardedAggregator
+
+    class SpyShardedAggregator(orig_sharded):
+        def __init__(self, *a, **k):
+            sharded_built.append(True)
+            super().__init__(*a, **k)
+
+    monkeypatch.setattr(sharded_agg, "ShardedAggregator",
+                        SpyShardedAggregator)
     log = _fake_log(n=6, dupes=2)
     _patch_transport(monkeypatch, log)
     ini = tmp_path / "ct.ini"
@@ -54,13 +76,17 @@ def test_ct_fetch_tpu_backend_and_statistics(tmp_path, monkeypatch, capsys):
         "backend = tpu\n"
         "batchSize = 64\n"
         "tableBits = 12\n"
-        f"aggStatePath = {state}\n"
+        + (f"meshShape = {mesh_shape}\n" if mesh_shape else "")
+        + f"aggStatePath = {state}\n"
         "healthAddr = \n"
         "nobars = true\n"
     )
     rc = ct_fetch.main(["-config", str(ini), "-nobars"])
     assert rc == 0
     assert state.exists()
+    # meshShape really drives aggregator selection (empty = all
+    # visible devices -> sharded on the 8-device virtual mesh).
+    assert bool(sharded_built) == expect_sharded
 
     rc = storage_statistics.main(["-config", str(ini), "-v", "1"])
     assert rc == 0
@@ -242,3 +268,4 @@ def test_ct_getcert(capsys):
 
     fields = hostder.parse_cert(der)
     assert fields.serial == (1001).to_bytes(2, "big")
+
