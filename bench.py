@@ -277,31 +277,14 @@ def main() -> int:
     now_hour = 500_000  # well before the template's 2031 expiry
 
     # Resident batches, stacked [G, B, L], built ON DEVICE from the
-    # ~1 KB signed template: broadcast the template row, then stamp a
-    # per-(batch, lane) counter into serial bytes 12..16 (epoch bytes
-    # 4..8 are restamped per sweep inside mega_step). H2D at setup is
-    # one template, not gigabytes — on the tunneled dev link the old
-    # host-stamped [G, B, L] upload took longer than the measurement.
-    base = np.frombuffer(tpl.leaf_der, dtype=np.uint8)
-    if base.size > pad_len:
-        raise BenchError(f"template {base.size}B > pad {pad_len}")
-    tlen = int(base.size)
-    lane_cols = tpl.serial_off + np.arange(12, 16, dtype=np.int32)
-
-    @jax.jit
-    def build_batches(base_row):
-        row = jnp.zeros((pad_len,), jnp.uint8).at[:tlen].set(base_row)
-        data = jnp.broadcast_to(row, (n_batches, batch, pad_len))
-        cnt = (jnp.arange(n_batches, dtype=jnp.uint32)[:, None] * batch
-               + jnp.arange(batch, dtype=jnp.uint32)[None, :])
-        cb = jnp.stack(
-            [(cnt >> 24) & 0xFF, (cnt >> 16) & 0xFF,
-             (cnt >> 8) & 0xFF, cnt & 0xFF], axis=-1
-        ).astype(jnp.uint8)
-        return data.at[:, :, lane_cols].set(cb)
-
-    datas = build_batches(jax.device_put(base))
-    lens = jnp.full((n_batches, batch), tlen, dtype=jnp.int32)
+    # ~1 KB signed template (syncerts.build_device_batches: lane
+    # counter in serial bytes 12..16; epoch bytes 4..8 are restamped
+    # per sweep inside mega_step).
+    try:
+        datas, lens = syncerts.build_device_batches(
+            tpl, n_batches, batch, pad_len)
+    except ValueError as err:
+        raise BenchError(str(err))
     issuer_idx = jax.device_put(np.zeros((batch,), np.int32))
     valid = jax.device_put(np.ones((batch,), bool))
     epoch_cols = tpl.serial_off + np.arange(4, 8, dtype=np.int32)

@@ -161,3 +161,45 @@ def stamp_batch_array(
         ).astype(np.uint8)
     lengths = np.full((batch,), base.size, dtype=np.int32)
     return data, lengths
+
+
+def build_device_batches(
+    template: CertTemplate,
+    n_batches: int,
+    batch: int,
+    pad_len: int,
+):
+    """Synthesize resident batches ON DEVICE from the signed template.
+
+    Returns ``(datas uint8[G, B, pad_len], lens int32[G, B])`` device
+    arrays. A per-(batch, lane) uint32 counter (``g * batch + lane``,
+    big-endian) is stamped into serial content bytes 12..16 — unique up
+    to 2^32 lanes; bytes 4..8 are left zero for callers that restamp a
+    per-sweep epoch on device (bench.py's mega_step). H2D traffic is
+    one ~1 KB template row instead of gigabytes of host-stamped rows
+    (on tunneled links the old upload took longer than the benchmark).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    base = np.frombuffer(template.leaf_der, dtype=np.uint8)
+    if base.size > pad_len:
+        raise ValueError(f"template ({base.size}B) exceeds pad length {pad_len}")
+    tlen = int(base.size)
+    lane_cols = template.serial_off + np.arange(12, 16, dtype=np.int32)
+
+    @jax.jit
+    def build(base_row):
+        row = jnp.zeros((pad_len,), jnp.uint8).at[:tlen].set(base_row)
+        data = jnp.broadcast_to(row, (n_batches, batch, pad_len))
+        cnt = (jnp.arange(n_batches, dtype=jnp.uint32)[:, None] * batch
+               + jnp.arange(batch, dtype=jnp.uint32)[None, :])
+        cb = jnp.stack(
+            [(cnt >> 24) & 0xFF, (cnt >> 16) & 0xFF,
+             (cnt >> 8) & 0xFF, cnt & 0xFF], axis=-1
+        ).astype(jnp.uint8)
+        return data.at[:, :, lane_cols].set(cb)
+
+    datas = build(jax.device_put(base))
+    lens = jnp.full((n_batches, batch), tlen, dtype=jnp.int32)
+    return datas, lens

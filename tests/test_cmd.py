@@ -268,4 +268,3 @@ def test_ct_getcert(capsys):
 
     fields = hostder.parse_cert(der)
     assert fields.serial == (1001).to_bytes(2, "big")
-
