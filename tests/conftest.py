@@ -34,3 +34,13 @@ def on_tpu() -> bool:
 requires_tpu = pytest.mark.skipif(
     os.environ.get("CT_TPU_TESTS", "") == "", reason="set CT_TPU_TESTS=1 to run"
 )
+
+
+def pytest_configure(config):
+    # pytest-timeout isn't in this image; register the mark so suites
+    # that do install it get real timeouts and bare runs stay clean.
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): per-test timeout (enforced only when "
+        "pytest-timeout is installed)",
+    )
