@@ -388,3 +388,29 @@ def test_reads_never_materialize_phantom_keys(cache):
     cache.set_insert(key, "m")
     cache.set_remove(key, "m")
     assert not cache.exists(key)
+
+
+def test_shared_client_thread_safety(cache):
+    """One RedisCache shared by many store workers (the production
+    shape: FilesystemDatabase holds a single client) must serialize
+    its socket correctly under contention — every insert lands, no
+    interleaved frames."""
+    key = cache.track(_key("hammer"))
+    n_threads, per = 8, 50
+    errs: list = []
+
+    def worker(t: int) -> None:
+        try:
+            for j in range(per):
+                cache.set_insert(key, f"t{t}-{j}")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs
+    assert cache.set_cardinality(key) == n_threads * per
