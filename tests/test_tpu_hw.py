@@ -1,0 +1,54 @@
+"""Real-hardware tier (CT_TPU_TESTS=1): the fused step on the chip.
+
+The reference gates its integration tier on a reachable Redis
+(rediscache_test.go:16-28); the analog here is a reachable TPU. Keep
+this tier tiny — one compile, a few seconds of chip time — it exists
+to prove the shipping step (device build -> parse -> filter ->
+fingerprint -> dedup insert -> counts) runs end to end on real
+hardware with exact results, not to benchmark it (bench.py does that).
+"""
+
+import numpy as np
+import pytest
+
+from tests.conftest import on_tpu, requires_tpu
+
+
+@requires_tpu
+@pytest.mark.timeout(300)
+def test_fused_step_on_hardware():
+    import jax
+    import jax.numpy as jnp
+
+    from ct_mapreduce_tpu.core import packing
+    from ct_mapreduce_tpu.ops import hashtable, pipeline
+    from ct_mapreduce_tpu.utils import syncerts
+
+    assert on_tpu(), "CT_TPU_TESTS=1 requires a TPU backend"
+    batch, pad_len = 4096, 1024
+    tpl = syncerts.make_template()
+    datas, lens = syncerts.build_device_batches(tpl, 1, batch, pad_len)
+    issuer_idx = jnp.zeros((batch,), jnp.int32)
+    valid = jnp.ones((batch,), bool)
+
+    step = jax.jit(pipeline.ingest_core, donate_argnums=(0,),
+                   static_argnames=("num_issuers", "max_probes"))
+    table = hashtable.make_table(1 << 14)
+    table, out = step(
+        table, datas[0], lens[0], issuer_idx, valid,
+        jnp.int32(500_000), jnp.int32(packing.DEFAULT_BASE_HOUR),
+        jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0,), jnp.int32),
+    )
+    wu = np.asarray(out.was_unknown)
+    assert wu.sum() == batch  # every lane unique → all fresh inserts
+    assert not np.asarray(out.host_lane).any()
+    assert int(np.asarray(table.count)) == batch
+
+    # Replay: nothing is fresh the second time (Redis SADD semantics).
+    table, out2 = step(
+        table, datas[0], lens[0], issuer_idx, valid,
+        jnp.int32(500_000), jnp.int32(packing.DEFAULT_BASE_HOUR),
+        jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0,), jnp.int32),
+    )
+    assert int(np.asarray(out2.was_unknown).sum()) == 0
+    assert int(np.asarray(table.count)) == batch
