@@ -99,20 +99,32 @@ def _contains_global(
     dest = _shard_of(keys, n_shards)
     home = hashtable._home_slot(keys, cap_loc)
     b = keys.shape[0]
+    W = min(hashtable.PROBE_WIDTH, max_probes)
 
-    def round_body(r, carry):
-        found, open_ = carry
-        slot = dest * cap_loc + ((home + (r * (r + 1)) // 2) & (cap_loc - 1))
-        cur = table_keys[slot]
-        match = jnp.all(cur == keys, axis=-1)
-        empty = jnp.all(cur == 0, axis=-1)
-        found = found | (match & open_)
-        open_ = open_ & ~match & ~empty
-        return found, open_
+    def cond(carry):
+        _r, _found, open_ = carry
+        return jnp.any(open_)
 
-    found, _ = jax.lax.fori_loop(
-        0, max_probes, round_body,
-        (jnp.zeros((b,), bool), jnp.ones((b,), bool)),
+    def round_body(carry):
+        r, found, open_ = carry
+        # Windowed early-exit scan (shared with hashtable.contains):
+        # typically ONE table gather instead of max_probes of them.
+        _slots, match_j, empty_j = hashtable._probe_window(
+            table_keys, keys, home, r, W, max_probes, cap_loc,
+            slot_base=dest * cap_loc,
+        )
+        found = found | (open_ & jnp.any(
+            match_j & (jnp.cumsum(empty_j, axis=-1) == 0), axis=-1
+        ))
+        still = open_ & ~jnp.any(match_j | empty_j, axis=-1)
+        r = jnp.where(still, r + W, r)
+        open_ = still & (r < max_probes)
+        return r, found, open_
+
+    _, found, _ = jax.lax.while_loop(
+        cond, round_body,
+        (jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
+         jnp.ones((b,), bool)),
     )
     return found
 

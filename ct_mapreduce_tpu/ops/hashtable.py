@@ -110,6 +110,36 @@ def _home_slot(keys: jax.Array, capacity: int) -> jax.Array:
     return (h & np.uint32(capacity - 1)).astype(jnp.int32)
 
 
+def _probe_window(
+    table_keys: jax.Array,
+    keys: jax.Array,
+    home: jax.Array,
+    r: jax.Array,
+    W: int,
+    max_probes: int,
+    chain_capacity: int,
+    slot_base: jax.Array | int = 0,
+):
+    """One W-wide window of triangular-chain probes: the shared access
+    pattern of ``insert``, ``contains`` and the sharded membership scan
+    (``slot_base`` offsets into a shard's row block).
+
+    Returns ``(slots [B, W], match_j [B, W], empty_j [B, W])`` with
+    positions past ``max_probes`` masked out of both match and empty.
+    """
+    rj = r[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]  # [B, W]
+    chain = (home[:, None] + (rj * (rj + 1)) // 2) & (chain_capacity - 1)
+    if isinstance(slot_base, int) and slot_base == 0:
+        slots = chain
+    else:
+        slots = slot_base[:, None] + chain
+    in_budget = rj < max_probes
+    cur = table_keys[slots]  # [B, W, 4]
+    match_j = jnp.all(cur == keys[:, None, :], axis=-1) & in_budget
+    empty_j = jnp.all(cur == 0, axis=-1) & in_budget
+    return slots, match_j, empty_j
+
+
 def _desentinel(keys: jax.Array) -> jax.Array:
     """Remap the (astronomically unlikely) all-zero fingerprint."""
     is_zero = jnp.all(keys == 0, axis=-1, keepdims=True)
@@ -162,12 +192,9 @@ def insert(
          pending, found, inserted, ovf) = carry
         # Probe window: W consecutive triangular-chain positions
         # starting at each lane's r, fetched in ONE gather.
-        rj = r[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]  # [B, W]
-        slots = (home[:, None] + (rj * (rj + 1)) // 2) & (capacity - 1)
-        in_budget = rj < max_probes
-        cur = table_keys[slots]  # [B, W, 4]
-        match_j = jnp.all(cur == keys[:, None, :], axis=-1) & in_budget
-        empty_j = jnp.all(cur == 0, axis=-1) & in_budget
+        slots, match_j, empty_j = _probe_window(
+            table_keys, keys, home, r, W, max_probes, capacity
+        )
         stop_j = match_j | empty_j
         any_stop = jnp.any(stop_j, axis=-1)
         jstar = jnp.argmax(stop_j, axis=-1).astype(jnp.int32)  # first stop
@@ -241,24 +268,42 @@ def insert(
 
 @functools.partial(jax.jit, static_argnames=("max_probes",))
 def contains(state: TableState, keys: jax.Array, max_probes: int = 32) -> jax.Array:
-    """Batch membership query (no mutation): bool[B]."""
+    """Batch membership query (no mutation): bool[B].
+
+    Same access structure as :func:`insert`: a W-wide window of chain
+    positions per gather, with a ``while_loop`` that exits as soon as
+    every lane has hit a match or an empty slot — the common case is
+    ONE table gather, not ``max_probes`` of them (each random-access
+    op costs ~5 ms on TPU regardless of batch width)."""
     capacity = state.keys.shape[0]
     keys = _desentinel(keys.astype(jnp.uint32))
     home = _home_slot(keys, capacity)
-
-    def round_body(r, carry):
-        found, open_ = carry
-        slot = (home + (r * (r + 1)) // 2) & (capacity - 1)
-        cur = state.keys[slot]
-        match = jnp.all(cur == keys, axis=-1)
-        empty = jnp.all(cur == 0, axis=-1)
-        found = found | (match & open_)
-        open_ = open_ & ~match & ~empty
-        return found, open_
-
     b = keys.shape[0]
-    found, _ = jax.lax.fori_loop(
-        0, max_probes, round_body, (jnp.zeros((b,), bool), jnp.ones((b,), bool))
+    W = min(PROBE_WIDTH, max_probes)
+
+    def cond(carry):
+        r, found, open_ = carry
+        return jnp.any(open_)
+
+    def round_body(carry):
+        r, found, open_ = carry
+        _slots, match_j, empty_j = _probe_window(
+            state.keys, keys, home, r, W, max_probes, capacity
+        )
+        found = found | (open_ & jnp.any(
+            match_j & (jnp.cumsum(empty_j, axis=-1) == 0), axis=-1
+        ))
+        # A lane stays open only if every in-budget window position was
+        # an occupied mismatch (chain continues past the window).
+        still = open_ & ~jnp.any(match_j | empty_j, axis=-1)
+        r = jnp.where(still, r + W, r)
+        open_ = still & (r < max_probes)
+        return r, found, open_
+
+    _, found, _ = jax.lax.while_loop(
+        cond, round_body,
+        (jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
+         jnp.ones((b,), bool)),
     )
     return found
 
