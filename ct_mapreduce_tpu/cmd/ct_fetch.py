@@ -48,7 +48,18 @@ class ProgressPrinter:
             rate = (pos - prev_pos) / (now - prev_t) if now > prev_t else 0.0
             self._last[url] = (now, pos)
             pct = 100.0 * pos / end if end else 100.0
-            parts.append(f"{url}: {pos}/{end} ({pct:.1f}%) {rate:,.0f}/s")
+            # ETA decorator parity with the reference's mpb bars
+            # (ct-fetch.go:317-330).
+            if rate > 0 and end > pos:
+                secs = (end - pos) / rate
+                eta = (f"{secs / 3600:.1f}h" if secs >= 3600
+                       else f"{secs / 60:.0f}m" if secs >= 60
+                       else f"{secs:.0f}s")
+            else:
+                eta = "--"
+            parts.append(
+                f"{url}: {pos}/{end} ({pct:.1f}%) {rate:,.0f}/s eta {eta}"
+            )
         return " | ".join(parts)
 
     def _run(self) -> None:
