@@ -414,3 +414,54 @@ def test_shared_client_thread_safety(cache):
         t.join(timeout=30)
     assert not errs
     assert cache.set_cardinality(key) == n_threads * per
+
+
+def test_statistics_v2_rededups_scan_duplicates(tmp_path, capsys):
+    """storage-statistics -v2 drains serial sets via SSCAN; Redis may
+    replay members (knowncertificates.go:65-96), so the report must
+    re-dedup client-side. Driven end to end against a duplicating
+    server through the real CLI."""
+    from tests import certgen
+
+    from ct_mapreduce_tpu.cmd import storage_statistics
+    from ct_mapreduce_tpu.ingest.sync import DatabaseSink
+    from ct_mapreduce_tpu.ingest.leaf import DecodedEntry
+    from ct_mapreduce_tpu.storage.certdb import FilesystemDatabase
+    from ct_mapreduce_tpu.storage.noop import NoopBackend
+    from ct_mapreduce_tpu.storage.rediscache import RedisCache
+
+    server = MiniRedis(scan_duplicate=True).start()
+    try:
+        future = datetime(2031, 6, 15, tzinfo=timezone.utc)
+        issuer = certgen.make_cert(serial=1, issuer_cn="Dup CA",
+                                   is_ca=True, not_after=future)
+        cache = RedisCache(server.address)
+        db = FilesystemDatabase(NoopBackend(), cache)
+        sink = DatabaseSink(db)
+        n = 40
+        for s in range(n):
+            leaf = certgen.make_cert(serial=1000 + s, issuer_cn="Dup CA",
+                                     is_ca=False, not_after=future)
+            sink.store(DecodedEntry(index=s, cert_der=leaf,
+                                    issuer_der=issuer, timestamp_ms=0,
+                                    entry_type=0), "dup-log")
+
+        ini = tmp_path / "ct.ini"
+        ini.write_text(f"redisHost = {server.address}\n")
+        rc = storage_statistics.main(["-config", str(ini), "-v", "2"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert f"{n} serials" in out
+        # The -v2 serial list carries each serial exactly once despite
+        # the server replaying SSCAN members.
+        serial_lines = [ln for ln in out.splitlines()
+                        if ln.strip().startswith("Serials: ")]
+        assert serial_lines, out
+        import ast
+
+        listed = ast.literal_eval(serial_lines[0].split(":", 1)[1].strip())
+        assert len(listed) == n
+        assert len(set(listed)) == n
+        cache.close()
+    finally:
+        server.stop()
