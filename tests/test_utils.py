@@ -74,3 +74,38 @@ def test_metrics_sink_and_dumper():
     assert "certIsFilteredOut.CA: 2" in text
     assert "entries_per_sec_per_chip" in text
     metrics.set_sink(InMemSink())  # reset global for other tests
+
+
+def test_build_device_batches_unique_valid_rows():
+    """The shared on-device batch synthesis: every row is the signed
+    template with a unique serial, lane counters span [0, G*B), epoch
+    bytes 4..8 stay zero for the caller, and the oversize guard fires."""
+    import numpy as np
+    import pytest
+
+    from ct_mapreduce_tpu.core import der as hostder
+    from ct_mapreduce_tpu.utils import syncerts
+
+    tpl = syncerts.make_template()
+    g, b, pad = 2, 64, 1024
+    datas, lens = syncerts.build_device_batches(tpl, g, b, pad)
+    datas = np.asarray(datas)
+    lens = np.asarray(lens)
+    assert datas.shape == (g, b, pad)
+    assert (lens == len(tpl.leaf_der)).all()
+
+    seen = set()
+    for gi in range(g):
+        for li in (0, 1, b - 1):
+            row = bytes(datas[gi, li, : lens[gi, li]])
+            fields = hostder.parse_cert(row)  # still canonical DER
+            assert fields.serial_len == syncerts.SERIAL_LEN
+            serial = row[tpl.serial_off : tpl.serial_off + tpl.serial_len]
+            assert serial[4:8] == b"\x00" * 4  # epoch bytes left zero
+            cnt = int.from_bytes(serial[12:16], "big")
+            assert cnt == gi * b + li  # lane counter layout
+            assert serial not in seen
+            seen.add(serial)
+
+    with pytest.raises(ValueError):
+        syncerts.build_device_batches(tpl, 1, 4, len(tpl.leaf_der) - 1)
