@@ -455,6 +455,15 @@ def parse_cert(der: bytes) -> CertFields:
                             if v_tag == TAG_BOOLEAN:  # critical flag
                                 vpos = v_off + v_len
                                 v_tag, v_len, v_off = read_tlv(der, vpos)
+                            if v_off + v_len > e_off + e_len:
+                                # extnValue overruns its Extension
+                                # frame: structurally invalid (Go's
+                                # asn1 errors on this; the device
+                                # walker's windowed read rejects it
+                                # too — caught by the mutation fuzz).
+                                raise DerError(
+                                    "extnValue overruns Extension frame"
+                                )
                             if v_tag == TAG_OCTET_STRING:
                                 if oid == OID_BASIC_CONSTRAINTS:
                                     bc_valid = True

@@ -211,6 +211,12 @@ def _parse_time_w(win, a, delta, p):
     def digits2(off):
         return (_wbyte(win, off) - 0x30) * 10 + (_wbyte(win, off + 1) - 0x30)
 
+    def is_digits2(off):
+        b0 = _wbyte(win, off)
+        b1 = _wbyte(win, off + 1)
+        return ((b0 >= 0x30) & (b0 <= 0x39)
+                & (b1 >= 0x30) & (b1 <= 0x39))
+
     yy = digits2(q)
     year_utc = jnp.where(yy >= 50, 1900 + yy, 2000 + yy)
     year_gen = yy * 100 + digits2(q + 2)
@@ -219,7 +225,18 @@ def _parse_time_w(win, a, delta, p):
     month = digits2(body + 2)
     day = digits2(body + 4)
     hour = digits2(body + 6)
-    ok = ok & (month >= 1) & (month <= 12) & (day >= 1) & (day <= 31) & (hour <= 23)
+    # Every byte feeding the expiry bucket must be a genuine ASCII
+    # digit — range checks alone let some mutated bytes alias into
+    # plausible values, silently corrupting the (expDate, issuer,
+    # serial) identity (caught by the walker/host mutation fuzz).
+    # Minutes/seconds are not validated: the bucket truncates to the
+    # hour (types.go:339-346), so they cannot affect identity.
+    digits_ok = (is_digits2(q) & is_digits2(body + 2)
+                 & is_digits2(body + 4) & is_digits2(body + 6)
+                 & jnp.where(is_utc, True, is_digits2(q + 2)))
+    ok = (ok & digits_ok
+          & (month >= 1) & (month <= 12) & (day >= 1) & (day <= 31)
+          & (hour <= 23))
 
     # Days-from-civil (Gregorian), valid for year ≥ 1583; all positive here.
     y = year - (month <= 2)
@@ -324,7 +341,14 @@ def _scan_extensions(rows: _Rows, ext_off, ext_end, alive0):
         has_crit = cok & (ctag == 0x01)
         dv = jnp.where(has_crit, dc + chlen + cclen, dc)
         vtag, vclen, vhlen, vok = _read_header_w(win, a, dv, p, ext_end)
-        val_ok = vok & (vtag == 0x04)
+        # extnValue must fit INSIDE its Extension frame (hlen + clen),
+        # not merely inside the extension list — an inflated value
+        # length would otherwise window into the next extension's
+        # bytes. The whole LANE is rejected (host-lane fallback), in
+        # lockstep with the host parser's DerError on the same input
+        # (pinned by the walker/host mutation fuzz).
+        overrun = ext_ok & vok & (dv + vhlen + vclen > hlen + clen)
+        val_ok = vok & (vtag == 0x04) & ~overrun
         # BasicConstraints ::= SEQUENCE { cA BOOLEAN DEFAULT FALSE, ... }
         db = dv + vhlen
         btag, bclen, bhlen, bok = _read_header_w(win, a, db, p, ext_end)
@@ -341,7 +365,7 @@ def _scan_extensions(rows: _Rows, ext_off, ext_end, alive0):
         dp_len = jnp.where(take_dp, vclen, dp_len)
         has_crldp = has_crldp | (is_dp & val_ok)
         p = jnp.where(active & hok, p + hlen + clen, p)
-        alive = alive & jnp.where(active, hok, True)
+        alive = alive & jnp.where(active, hok & ~overrun, True)
         return r + 1, p, is_ca, has_crldp, dp_off, dp_len, alive
 
     _, p, is_ca, has_crldp, dp_off, dp_len, alive = jax.lax.while_loop(
