@@ -169,8 +169,10 @@ int64_t ctmr_decode_entries(
     uint64_t ts = r.uint(8);
     uint64_t ety = r.uint(2);
     if (!r.ok) { status[i] = CTMR_BAD_LEAF; continue; }
-    ts_ms[i] = (int64_t)ts;
-    entry_ty[i] = (int32_t)ety;
+    // ts_ms/entry_ty are stored only once every BAD_* path is behind
+    // us (below, before the TOO_LONG check): the Python codec yields
+    // them only when the whole decode succeeds, and the conformance
+    // fuzz pins byte equality of every output array.
 
     int64_t cert_off = 0, cert_len = 0;
     if (ety == 0) {  // x509_entry: leaf cert in leaf_input
@@ -238,6 +240,8 @@ int64_t ctmr_decode_entries(
       if (!chain_ok) { status[i] = CTMR_BAD_LEAF; continue; }
     }
 
+    ts_ms[i] = (int64_t)ts;
+    entry_ty[i] = (int32_t)ety;
     if (cert_len > pad_len) { status[i] = CTMR_TOO_LONG; continue; }
     std::memcpy(row, cert_src, (size_t)cert_len);
     length[i] = (int32_t)cert_len;

@@ -346,8 +346,16 @@ def _scan_extensions(rows: _Rows, ext_off, ext_end, alive0):
         # length would otherwise window into the next extension's
         # bytes. The whole LANE is rejected (host-lane fallback), in
         # lockstep with the host parser's DerError on the same input
-        # (pinned by the walker/host mutation fuzz).
-        overrun = ext_ok & vok & (dv + vhlen + vclen > hlen + clen)
+        # (pinned by the walker/host mutation fuzz). The overrun check
+        # uses a limit-free header re-read: a value whose end ALSO
+        # crosses ext_end makes vok itself False, which must still
+        # count as an overrun, not a silent skip (the list bound is a
+        # superset of the frame bound). Same window bytes — pure
+        # arithmetic, no extra gather.
+        _vt2, vclen2, vhlen2, vok2 = _read_header_w(
+            win, a, dv, p, jnp.int32(2**30)
+        )
+        overrun = ext_ok & vok2 & (dv + vhlen2 + vclen2 > hlen + clen)
         val_ok = vok & (vtag == 0x04) & ~overrun
         # BasicConstraints ::= SEQUENCE { cA BOOLEAN DEFAULT FALSE, ... }
         db = dv + vhlen

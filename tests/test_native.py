@@ -187,3 +187,42 @@ def test_decode_threaded_matches_single():
     np.testing.assert_array_equal(single.entry_type, multi.entry_type)
     np.testing.assert_array_equal(single.status, multi.status)
     assert single.issuers == multi.issuers
+
+
+def test_wire_mutation_fuzz_native_python_agreement():
+    """Seeded mutation fuzz over RFC 6962 wire bytes: the C++ decoder
+    and the pure-Python codec must agree on status, packed cert bytes,
+    timestamps and issuer DER for every mutant (the decode path feeds
+    the device pipeline, so silent divergence corrupts identities)."""
+    if not available():
+        pytest.skip("native library unavailable")
+
+    rng = np.random.default_rng(20260730)
+    base_lis, base_eds, _expect, _issuer = _wire_batch()
+    lis, eds = [], []
+    for _ in range(250):
+        j = int(rng.integers(len(base_lis)))
+        li = base_lis[j]
+        ed = base_eds[j]
+        # Mutate the BASE64 TEXT half the time (exercises b64
+        # validation parity) and the underlying bytes otherwise.
+        if rng.random() < 0.5 and li:
+            pos = int(rng.integers(len(li)))
+            li = li[:pos] + chr(33 + int(rng.integers(90))) + li[pos + 1:]
+        elif ed:
+            raw = bytearray(base64.b64decode(ed))
+            if raw:
+                pos = int(rng.integers(len(raw)))
+                raw[pos] ^= int(rng.integers(1, 256))
+                ed = base64.b64encode(bytes(raw)).decode()
+        lis.append(li)
+        eds.append(ed)
+
+    nat = leafpack.decode_raw_batch(lis, eds, 2048, workers=1)
+    py = leafpack._decode_python(lis, eds, 2048)
+    np.testing.assert_array_equal(nat.status, py.status)
+    np.testing.assert_array_equal(nat.length, py.length)
+    np.testing.assert_array_equal(nat.data, py.data)
+    np.testing.assert_array_equal(nat.timestamp_ms, py.timestamp_ms)
+    np.testing.assert_array_equal(nat.entry_type, py.entry_type)
+    assert nat.issuers == py.issuers
