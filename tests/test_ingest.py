@@ -552,3 +552,51 @@ def test_sync_multi_log_shared_sink():
     # Independent per-log cursors at each tree size.
     assert db.get_log_state("ct.example.com/a").max_entry == 3
     assert db.get_log_state("ct.example.com/b").max_entry == 4
+
+
+def test_sync_contention_stress_exact_totals():
+    """The -race-tier analog (the reference runs `go test -race`,
+    .travis.yml:13): four logs with overlapping serials, four store
+    workers, a deliberately ragged flush size and pipelining depth 3 —
+    any lost/duplicated dispatch under contention breaks the exact
+    totals, which are asserted to the entry."""
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+
+    issuer_der = certgen.make_cert(serial=1, issuer_cn="Race CA",
+                                   is_ca=True, not_after=FUTURE)
+
+    def leaf(s):
+        return certgen.make_cert(
+            serial=s, issuer_cn="Race CA", subject_cn="r.example.com",
+            is_ca=False, not_after=FUTURE,
+        )
+
+    logs = []
+    unique = set()
+    for k in range(4):
+        log = FakeLog(url=f"https://ct.example.com/race{k}")
+        # Serial windows overlap between neighboring logs.
+        for s in range(900 + 10 * k, 900 + 10 * k + 17):
+            log.add_cert(leaf(s), issuer_der)
+            unique.add(s)
+        logs.append(log)
+
+    agg = TpuAggregator(
+        capacity=1 << 12, batch_size=32,
+        now=datetime.datetime(2025, 1, 1, tzinfo=UTC),
+    )
+    db = _db()
+    sink = AggregatorSink(agg, flush_size=7, device_queue_depth=3)
+    engine = LogSyncEngine(sink, db, num_threads=4)
+    engine.start_store_threads()
+    for log in logs:
+        engine.sync_log(log.url, transport=log.transport)
+    engine.wait_for_downloads(timeout=90)
+    engine.stop()
+
+    snap = agg.drain()
+    assert snap.total == len(unique), (snap.total, len(unique))
+    assert sink.entries_in == 4 * 17
+    for k in range(4):
+        st = db.get_log_state(f"ct.example.com/race{k}")
+        assert st.max_entry == 17
