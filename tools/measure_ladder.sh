@@ -1,0 +1,32 @@
+#!/bin/bash
+# Unattended hardware-measurement ladder for the tunneled axon TPU.
+#
+# Waits for the pool (each claim attempt blocks ~25 min before erring
+# UNAVAILABLE during an outage), then runs the full probe sequence and
+# the headline bench, logging everything to $CT_LADDER_LOG. Safe to
+# leave running across a pool outage: claims exit on their own — never
+# SIGTERM a mid-claim process (observed to extend outages).
+#
+#   nohup tools/measure_ladder.sh >/dev/null 2>&1 &
+#   tail -f /tmp/tpu_session.log
+cd "$(dirname "$0")/.."
+log=${CT_LADDER_LOG:-/tmp/tpu_session.log}
+echo "=== session start $(date) ===" >> "$log"
+while true; do
+  timeout 1800 python -c "import jax; d=jax.devices(); print('CLAIMED', d)" >> "$log" 2>&1
+  if [ $? -eq 0 ]; then break; fi
+  echo "--- still down $(date) ---" >> "$log"
+  sleep 45
+done
+echo "=== pool up $(date); running ladder ===" >> "$log"
+echo "--- opcost 131072 ---" >> "$log"
+timeout 1500 python tools/opcost.py 131072 >> "$log" 2>&1
+echo "--- microbench 131072 ---" >> "$log"
+timeout 1500 python tools/microbench.py 131072 >> "$log" 2>&1
+echo "--- microbench 1048576 ---" >> "$log"
+timeout 1500 python tools/microbench.py 1048576 >> "$log" 2>&1
+echo "--- insert_sweep ---" >> "$log"
+timeout 3000 python tools/insert_sweep.py >> "$log" 2>&1
+echo "--- bench.py full ---" >> "$log"
+CT_BENCH_WATCHDOG_SECS=520 timeout 1200 python bench.py >> "$log" 2>&1
+echo "=== ladder done $(date) ===" >> "$log"
