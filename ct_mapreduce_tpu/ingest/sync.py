@@ -207,8 +207,14 @@ class AggregatorSink:
         issuer_idx = np.zeros((n,), np.int32)
         oversized: list[tuple[bytes, bytes]] = []
         # Every DecodedBatch producer computes issuer groups
-        # (leafpack.decode_raw_batch native/threaded/python paths).
-        assert dec.issuer_group is not None, "producer without groups"
+        # (leafpack.decode_raw_batch native/threaded/python paths); a
+        # third-party producer that omits them violates the contract.
+        # Not an assert: stripped under `python -O` the failure would
+        # surface as an opaque TypeError below.
+        if dec.issuer_group is None:
+            raise ValueError(
+                "DecodedBatch producer did not compute issuer groups "
+                "(issuer_group/group_issuers are required)")
         # Vectorized bookkeeping: per-GROUP registry work (a handful of
         # distinct issuers per batch), numpy for the per-entry mapping
         # — no 64K-iteration Python loop.

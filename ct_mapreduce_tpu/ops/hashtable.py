@@ -81,9 +81,25 @@ import numpy as np
 # Chain positions examined per probe round (one gather). Wider windows
 # resolve more lanes in round 1 (P(all W occupied) = load^W) at the
 # price of a W-times-larger gather; env-tunable for hardware sweeps.
-PROBE_WIDTH = int(os.environ.get("CTMR_PROBE_WIDTH", "4"))
-if PROBE_WIDTH < 1:
-    raise ValueError(f"CTMR_PROBE_WIDTH must be >= 1, got {PROBE_WIDTH}")
+def _probe_width_from_env() -> int:
+    raw = os.environ.get("CTMR_PROBE_WIDTH", "4")
+    try:
+        width = int(raw)
+        if width < 1:
+            raise ValueError
+    except ValueError:
+        # A malformed env var must not break `import ct_mapreduce_tpu`
+        # for CLI paths that never probe; degrade to the default loudly.
+        import warnings
+
+        warnings.warn(
+            f"ignoring CTMR_PROBE_WIDTH={raw!r} (want an int >= 1); "
+            "using 4", stacklevel=2)
+        return 4
+    return width
+
+
+PROBE_WIDTH = _probe_width_from_env()
 
 
 class TableState(NamedTuple):

@@ -25,6 +25,7 @@ cluster, string-typed values only.
 
 from __future__ import annotations
 
+import bisect
 import fnmatch
 import socket
 import threading
@@ -370,13 +371,23 @@ class MiniRedis:
             # actually happens regardless of the client's COUNT hint
             # (COUNT is advisory in Redis anyway).
             count = min(count, 16)
-        start = int(cursor)
+        # The cursor names the last member returned ("1:<member>"), not a
+        # numeric index: a deletion between pages must not shift later
+        # members past the cursor (Redis guarantees elements present for
+        # the whole scan are returned at least once). Clients treat the
+        # cursor as opaque, comparing only against "0" — as real Redis
+        # requires.
+        if cursor == "0":
+            start, prev = 0, None
+        else:
+            prev = cursor[2:]
+            start = bisect.bisect_right(items, prev)
         page = items[start:start + count]
-        if self.scan_duplicate and start > 0 and items:
+        next_cursor = "0" if not page or start + count >= len(items) \
+            else "1:" + page[-1]
+        if self.scan_duplicate and prev is not None and items:
             # Model Redis's may-return-duplicates contract: replay the
             # last member of the previous page at the head of this one.
-            page = [items[start - 1]] + page
-        nxt = start + count
-        next_cursor = "0" if nxt >= len(items) else str(nxt)
+            page = [prev] + page
         return _array([_bulk(next_cursor),
                        _array([_bulk(m) for m in page])])

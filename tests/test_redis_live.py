@@ -331,6 +331,43 @@ def test_sscan_duplicates_are_dedupable():
         server.stop()
 
 
+def test_sscan_deletion_between_pages_skips_nothing():
+    """Redis guarantees members present from scan start to scan end are
+    returned at least once. A deletion between pages must not shift
+    later members past the cursor (the index-cursor bug the advisor
+    flagged: removing an already-returned member used to skip the next
+    unreturned one)."""
+    from ct_mapreduce_tpu.storage.rediscache import RedisCache
+
+    server = MiniRedis().start()
+    try:
+        c = RedisCache(server.address)
+        members = [f"s{i:03d}" for i in range(40)]
+        for m in members:
+            c.set_insert("delscan", m)
+        seen: list[str] = []
+        cursor = "0"
+        pages = 0
+        while True:
+            cursor, page = c.client.execute(
+                "SSCAN", "delscan", cursor, "COUNT", "10")
+            seen.extend(page)
+            pages += 1
+            if pages == 1:
+                # Remove an already-returned member mid-scan: with a
+                # numeric index cursor this shifted every later member
+                # down one slot, silently skipping one.
+                assert c.set_remove("delscan", page[0]) is True
+            if cursor == "0":
+                break
+        assert pages > 1  # multi-page scan actually happened
+        survivors = set(members) - {seen[0]}
+        assert survivors <= set(seen)  # no survivor skipped
+        c.close()
+    finally:
+        server.stop()
+
+
 def test_reconnect_after_server_restart():
     """Kill the server mid-session, restart it on the same port: the
     client's retry loop must transparently reconnect."""

@@ -13,7 +13,10 @@ cd "$(dirname "$0")/.."
 log=${CT_LADDER_LOG:-/tmp/tpu_session.log}
 echo "=== session start $(date) ===" >> "$log"
 while true; do
-  timeout 1800 python -c "import jax; d=jax.devices(); print('CLAIMED', d)" >> "$log" 2>&1
+  # No timeout on the probe: a claim errors out on its own (~25 min during
+  # an outage), and SIGTERMing a mid-claim process has been observed to
+  # extend outages. Let it finish either way.
+  python tools/probe_pool.py >> "$log" 2>&1
   if [ $? -eq 0 ]; then break; fi
   echo "--- still down $(date) ---" >> "$log"
   sleep 45

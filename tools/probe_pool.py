@@ -1,0 +1,27 @@
+"""One-shot TPU pool probe: claim the device, time it, run a trivial op.
+
+Writes status lines to stdout (redirect to a file — see the bash pitfalls
+note in the project memory: never pipe long runs through tail under
+timeout). Exits 0 iff a device was claimed and a tiny op round-tripped.
+"""
+import sys
+import time
+
+t0 = time.time()
+print(f"probe start {time.strftime('%Y-%m-%d %H:%M:%S')}", flush=True)
+try:
+    import jax
+
+    devs = jax.devices()
+    t1 = time.time()
+    print(f"CLAIMED after {t1 - t0:.1f}s: {devs}", flush=True)
+    import jax.numpy as jnp
+
+    x = jnp.arange(8)
+    val = int(jnp.sum(x))
+    t2 = time.time()
+    print(f"op ok ({val}) after {t2 - t1:.1f}s", flush=True)
+    sys.exit(0)
+except Exception as e:  # noqa: BLE001 - report any claim failure
+    print(f"FAILED after {time.time() - t0:.1f}s: {type(e).__name__}: {e}", flush=True)
+    sys.exit(1)
