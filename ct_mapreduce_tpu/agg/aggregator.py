@@ -780,14 +780,19 @@ class TpuAggregator:
             allow_pickle=True,
         )
 
-    def load_checkpoint(self, path: str) -> None:
+    def _asarray(self, arr: np.ndarray):
+        """Checkpoint rows → table-state arrays (device put). The
+        host-only snapshot reader overrides this to stay in NumPy."""
         import jax.numpy as jnp
 
+        return jnp.asarray(arr)
+
+    def load_checkpoint(self, path: str) -> None:
         z = np.load(path, allow_pickle=True)
         self.table = hashtable.TableState(
-            keys=jnp.asarray(z["keys"]),
-            meta=jnp.asarray(z["meta"]),
-            count=jnp.asarray(z["count"]),
+            keys=self._asarray(z["keys"]),
+            meta=self._asarray(z["meta"]),
+            count=self._asarray(z["count"]),
         )
         self._device_written = bool(np.asarray(z["count"]).sum() > 0)
         self.capacity = int(z["keys"].shape[0])
@@ -808,3 +813,41 @@ class TpuAggregator:
             int(k): set(v)
             for k, v in json.loads(z["dn_sets"].tobytes().decode()).items()
         }
+
+
+class HostSnapshotAggregator(TpuAggregator):
+    """Read-only snapshot consumer for ``storage-statistics --backend=tpu``.
+
+    The report is pure host work (regroup + count + print,
+    /root/reference/cmd/storage-statistics/storage-statistics.go:28-99),
+    so this subclass keeps the whole table state in NumPy: constructing
+    it never allocates device buffers, and a report can run while the
+    TPU pool is unavailable. Drain, regroup, and the host/device
+    overlap check share the parent's code paths bit for bit — only the
+    array residency hooks change.
+    """
+
+    def _make_table(self, capacity: int):
+        if capacity & (capacity - 1):
+            raise ValueError(f"capacity must be a power of two, got {capacity}")
+        return hashtable.TableState(
+            keys=np.zeros((capacity, 4), np.uint32),
+            meta=np.zeros((capacity,), np.uint32),
+            count=np.zeros((), np.int32),
+        )
+
+    def _asarray(self, arr: np.ndarray):
+        return np.asarray(arr)
+
+    # _drain_table is inherited: hashtable.drain_np is already pure
+    # NumPy over this subclass's host-resident arrays.
+
+    def _device_contains(self, fps: np.ndarray) -> np.ndarray:
+        return hashtable.contains_np(
+            np.asarray(self.table.keys), fps, max_probes=self.max_probes
+        )
+
+    def _device_step_packed(self, batch):
+        raise RuntimeError(
+            "HostSnapshotAggregator is read-only (reports); "
+            "use TpuAggregator/ShardedAggregator to ingest")

@@ -36,7 +36,7 @@ def report_from_tpu_snapshot(config: CTConfig, out, verbosity: int = 0) -> int:
     """
     import os
 
-    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.agg.aggregator import HostSnapshotAggregator
     from ct_mapreduce_tpu.core.types import ExpDate, Serial
 
     path = config.agg_state_path
@@ -47,7 +47,10 @@ def report_from_tpu_snapshot(config: CTConfig, out, verbosity: int = 0) -> int:
             file=out,
         )
         return 1
-    agg = TpuAggregator(capacity=1 << 10)
+    # Host-only snapshot reader: the report is pure host work, so it
+    # must not allocate device buffers or wait on TPU acquisition
+    # (reports must stay runnable during pool outages).
+    agg = HostSnapshotAggregator(capacity=1 << 10)
     agg.load_checkpoint(path)
     snap = agg.drain()
 
@@ -143,7 +146,30 @@ def report_from_tpu_snapshot(config: CTConfig, out, verbosity: int = 0) -> int:
         f"{total_serials} serials, {total_crls} crls",
         file=out,
     )
+    # Per-log checkpoint states print in TPU mode too: ct-fetch
+    # dual-writes the cursor through the same database facade
+    # regardless of backend, so the walk is identical to database mode
+    # (storage-statistics.go:86-98).
+    database, _cache, _backend = get_configured_storage(config)
+    print_log_status(config, database, out)
     return 0
+
+
+def print_log_status(config: CTConfig, database, out) -> None:
+    """The "Log status:" section, shared by both report paths
+    (/root/reference/cmd/storage-statistics/storage-statistics.go:86-98).
+
+    Headers print unconditionally; the URL walk is gated on the
+    reference's string-length quirk (:86-90).
+    """
+    from ct_mapreduce_tpu.ingest.ctclient import short_url
+
+    print("", file=out)
+    print("Log status:", file=out)
+    if config.log_url_list and len(config.log_url_list) > 5:
+        for url in config.log_urls():
+            state = database.get_log_state(short_url(url))
+            print(str(state), file=out)
 
 
 def report_from_database(config: CTConfig, out, verbosity: int = 0) -> int:
@@ -197,16 +223,7 @@ def report_from_database(config: CTConfig, out, verbosity: int = 0) -> int:
         file=out,
     )
 
-    # Headers print unconditionally; the URL walk is gated on the
-    # reference's string-length quirk (storage-statistics.go:86-90).
-    print("", file=out)
-    print("Log status:", file=out)
-    if config.log_url_list and len(config.log_url_list) > 5:
-        for url in config.log_urls():
-            from ct_mapreduce_tpu.ingest.ctclient import short_url
-
-            state = database.get_log_state(short_url(url))
-            print(str(state), file=out)
+    print_log_status(config, database, out)
     return 0
 
 

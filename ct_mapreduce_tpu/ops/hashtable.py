@@ -324,6 +324,37 @@ def contains(state: TableState, keys: jax.Array, max_probes: int = 32) -> jax.Ar
     return found
 
 
+def contains_np(table_keys: np.ndarray, keys: np.ndarray,
+                max_probes: int = 32) -> np.ndarray:
+    """NumPy mirror of :func:`contains` — same home slot, triangular
+    chain, and match-before-first-empty invariant — for host-only
+    snapshot reads (storage-statistics is pure host work and must not
+    allocate device buffers or wait on TPU acquisition).
+
+    Vectorized (drain probes every host-lane serial in one call), with
+    the batch chunked to bound the [chunk, max_probes, 4] gather."""
+    capacity = table_keys.shape[0]
+    if capacity & (capacity - 1):
+        raise ValueError(f"capacity must be a power of two, got {capacity}")
+    keys = keys.astype(np.uint32, copy=True).reshape(-1, 4)
+    zero = ~keys.any(axis=-1)
+    keys[zero, :] = 0
+    keys[zero, 3] = 1  # _desentinel
+    mask = capacity - 1
+    home = (keys[:, 0] ^ (keys[:, 1] * np.uint32(0x9E3779B9))).astype(np.int64)
+    r = np.arange(max_probes, dtype=np.int64)
+    tri = (r * (r + 1)) // 2
+    out = np.zeros((keys.shape[0],), bool)
+    for start in range(0, keys.shape[0], 65536):
+        sl = slice(start, start + 65536)
+        slots = (home[sl, None] + tri[None, :]) & mask  # [b, P]
+        rows = table_keys[slots]  # [b, P, 4]
+        match = (rows == keys[sl, None, :]).all(axis=-1)
+        empty = ~rows.any(axis=-1)
+        out[sl] = (match & (np.cumsum(empty, axis=1) == 0)).any(axis=1)
+    return out
+
+
 def occupied(state: TableState) -> jax.Array:
     """bool[capacity] occupancy mask."""
     return jnp.any(state.keys != 0, axis=-1)

@@ -194,6 +194,67 @@ def test_storage_statistics_tpu_v2_v3(tmp_path, monkeypatch, capsys):
     assert "count-only" in out
 
 
+def test_storage_statistics_tpu_log_status_and_host_only(
+        tmp_path, monkeypatch, capsys):
+    """TPU mode prints the per-log "Log status:" section exactly like
+    database mode (storage-statistics.go:86-98) — the cursor is
+    dual-written through the same facade regardless of backend — and
+    the report is pure host work: the snapshot reader's table state
+    stays NumPy end to end (report must run during TPU pool outages)."""
+    import numpy as np
+
+    log = _fake_log(n=5)
+    _patch_transport(monkeypatch, log)
+    certs = tmp_path / "certs"
+    state = tmp_path / "agg.npz"
+    ini = tmp_path / "ct.ini"
+    ini.write_text(
+        f"logList = {log.url}\n"
+        "backend = tpu\n"
+        "batchSize = 64\n"
+        "tableBits = 12\n"
+        f"certPath = {certs}\n"
+        f"aggStatePath = {state}\n"
+        "healthAddr = \n"
+    )
+    assert ct_fetch.main(["-config", str(ini), "-nobars"]) == 0
+
+    rc = storage_statistics.main(["-config", str(ini)])
+    assert rc == 0
+    tpu_out = capsys.readouterr().out
+    assert "Log status:" in tpu_out
+    assert "MaxEntry=5" in tpu_out
+    tpu_status = tpu_out.split("Log status:")[1]
+
+    # Database mode over the same certPath prints the identical status
+    # lines (same facade walk, backend-fallback read of the cursor).
+    buf = io.StringIO()
+    rc = storage_statistics.report_from_database(
+        CTConfig.load(["-config", str(ini)]), buf)
+    assert rc == 0
+    db_status = buf.getvalue().split("Log status:")[1]
+    # LastUpdateTime differs per read only if rewritten; here both read
+    # the same stored state — the lines must match byte for byte.
+    assert tpu_status == db_status
+
+    # Host-only residency: no jax arrays anywhere in the read path, and
+    # the drain matches the device aggregator's drain on the same file.
+    from ct_mapreduce_tpu.agg.aggregator import (
+        HostSnapshotAggregator, TpuAggregator)
+
+    host = HostSnapshotAggregator(capacity=1 << 10)
+    host.load_checkpoint(str(state))
+    assert isinstance(host.table.keys, np.ndarray)
+    host_snap = host.drain()
+    assert isinstance(host.table.keys, np.ndarray)
+    dev = TpuAggregator(capacity=1 << 10)
+    dev.load_checkpoint(str(state))
+    dev_snap = dev.drain()
+    assert host_snap.counts == dev_snap.counts
+    assert host_snap.crls == dev_snap.crls
+    assert host_snap.dns == dev_snap.dns
+
+
 def test_ct_fetch_requires_loglist(capsys):
     rc = ct_fetch.main(["-nobars"])
     assert rc == 2

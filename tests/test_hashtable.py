@@ -151,3 +151,20 @@ def test_randomized_parity_vs_python_set():
             batch_first[t] = True
         oracle |= set(batch_first)
     assert int(state.count) == len(oracle)
+
+
+def test_contains_np_matches_device_contains():
+    """The NumPy membership mirror (host-only storage-statistics) agrees
+    with the jitted `contains` on present, absent, and all-zero keys."""
+    state = ht.make_table(512)
+    keys = rand_keys(200, seed=21)
+    meta = np.arange(200, dtype=np.uint32)
+    state, _, overflow = ht.insert(state, keys, meta, np.ones(200, bool))
+    assert not np.asarray(overflow).any()
+
+    probe = np.concatenate([keys[:50], rand_keys(50, seed=22),
+                            np.zeros((1, 4), np.uint32)])
+    dev = np.asarray(ht.contains(state, probe))
+    host = ht.contains_np(np.asarray(state.keys), probe)
+    np.testing.assert_array_equal(host, dev)
+    assert host[:50].all()
