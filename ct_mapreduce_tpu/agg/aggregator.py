@@ -18,11 +18,15 @@ reference (serial dedup sets, per-issuer CRL/DN sets,
   (/root/reference/cmd/ct-fetch/ct-fetch.go:206-225).
 
 Determinism note: a certificate either always takes the device path or
-always takes the host path (the routing predicates are functions of the
-cert alone, except probe overflow — and an overflowed key stays
-overflowed, since the table only fills). The two dedup domains are
-therefore disjoint; a belt-and-braces host-set check on device-unknown
-lanes guards the pathological cross-encoding case.
+always takes the host path for the routing predicates that are
+functions of the cert alone. Probe overflow is the exception — an
+overflowed key spills to the host lane, and after a grow-and-rehash
+(load-factor policy) the same key may later insert on device — so the
+two dedup domains can OVERLAP. Exactness rests on the cross-domain
+guards: the host lane probes device membership before counting
+(`_device_known_flags`), the device lane checks the host sets on
+unknown lanes (cross-encoding guard in `_consume_out`), and `drain()`
+subtracts the host∩device overlap in one batched probe.
 
 ``drain()`` reconstructs exactly what ``storage-statistics`` prints
 (/root/reference/cmd/storage-statistics/storage-statistics.go:28-99):
@@ -35,8 +39,10 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import sys
 import tempfile
 import threading
+import time
 from dataclasses import dataclass, field
 from datetime import datetime, timezone
 from typing import Optional
@@ -48,7 +54,7 @@ from ct_mapreduce_tpu.core import der as hostder
 from ct_mapreduce_tpu.core import packing
 from ct_mapreduce_tpu.core.types import ExpDate, Issuer
 from ct_mapreduce_tpu.ops import hashtable, pipeline
-from ct_mapreduce_tpu.telemetry.metrics import incr_counter
+from ct_mapreduce_tpu.telemetry.metrics import incr_counter, set_gauge
 
 
 class IssuerRegistry:
@@ -151,6 +157,8 @@ class PendingIngest:
         agg = self._agg
         with contextlib.suppress(ValueError):
             agg._outstanding.remove(self)
+        agg._inflight_lanes = max(
+            0, agg._inflight_lanes - len(self._res.was_unknown))
         res = self._res
         host_lane_total = 0
         for batch, device_pos, lane_of, out in self._chunks:
@@ -182,6 +190,31 @@ class AggregateSnapshot:
         return sorted(out)
 
 
+def _reinsert_chunks(table, keys, meta, valid, max_probes: int):
+    """All reinsert chunks in ONE jitted execution; overflow count
+    accumulates on device and is read back once by the caller."""
+    import functools as _functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @_functools.partial(jax.jit, static_argnames=("max_probes",),
+                        donate_argnums=(0,))
+    def run(table, keys, meta, valid, max_probes):
+        def body(i, carry):
+            table, ovf = carry
+            table, _wu, o = hashtable.insert(
+                table, keys[i], meta[i], valid[i], max_probes=max_probes
+            )
+            return table, ovf + o.sum(dtype=jnp.int32)
+
+        return jax.lax.fori_loop(
+            0, keys.shape[0], body, (table, jnp.int32(0))
+        )
+
+    return run(table, keys, meta, valid, max_probes=max_probes)
+
+
 class TpuAggregator:
     def __init__(
         self,
@@ -191,12 +224,36 @@ class TpuAggregator:
         cn_prefixes: tuple[str, ...] = (),
         max_probes: int = 32,
         now: Optional[datetime] = None,
+        grow_at: float = 0.7,
+        max_capacity: int = 1 << 28,
     ) -> None:
         self.table = self._make_table(capacity)
         self.capacity = capacity
         self.batch_size = batch_size
         self.base_hour = base_hour
         self.max_probes = max_probes
+        # Load-factor policy: when the (estimated) fill would exceed
+        # grow_at × capacity, the table grows-and-rehashes to the next
+        # power of two (up to max_capacity; past the cap, probe
+        # overflow spills lanes to the exact host lane with the
+        # `overflow` metric — counts stay exact either way). grow_at
+        # <= 0 disables growth. A full log replay lives at high load;
+        # insert cost rises with load factor, so unbounded fill would
+        # silently degrade the measured rate (r03 hardware run:
+        # per-chunk time grew 4.92s → 7.12s by 36% load).
+        self.grow_at = grow_at
+        if max_capacity & (max_capacity - 1):
+            # Growth targets double from a power-of-two capacity; a
+            # ragged ceiling would make grow() raise on every ingest
+            # once tripped. Round DOWN so the ceiling stays honest.
+            max_capacity = 1 << (max_capacity.bit_length() - 1)
+        self.max_capacity = max_capacity
+        # Host-side running fill estimate: device inserts folded in at
+        # complete() time, plus lanes currently in flight (upper
+        # bound). Exact fill is read from the device only when the
+        # estimate trips the threshold.
+        self._table_fill = 0
+        self._inflight_lanes = 0
         self.registry = IssuerRegistry()
         self._fixed_now = now
         # Host-exact lane state: (issuer_idx, exp_hour) → set of serial bytes.
@@ -238,6 +295,95 @@ class TpuAggregator:
         return np.asarray(
             hashtable.contains(self.table, jnp.asarray(fps),
                                max_probes=self.max_probes),
+        )
+
+    # -- load-factor policy ---------------------------------------------
+    def _table_fill_exact(self) -> int:
+        """Occupied-slot count, synced from the device."""
+        return int(np.asarray(self.table.count))
+
+    def _rebuild_table(self, new_capacity: int) -> int:
+        """Fresh empty table at ``new_capacity``; returns the actual
+        capacity (mesh-sharded subclasses may round it)."""
+        self.table = self._make_table(new_capacity)
+        return new_capacity
+
+    def _bulk_reinsert(self, keys: np.ndarray, meta: np.ndarray) -> int:
+        """Re-hash drained rows into the (fresh) table; returns the
+        number of rows that overflowed their probe chains.
+
+        One device EXECUTION for the whole reinsert (fori_loop over
+        chunk-shaped inserts) with one readback at the end: on the
+        tunneled stack every execution charges ~0.2s on the next D2H
+        read, so a per-chunk read loop would add minutes to a large
+        grow (BENCHLOG.md platform notes)."""
+        import jax.numpy as jnp
+
+        n = len(keys)
+        if n == 0:
+            return 0
+        chunk = min(1 << 16, max(1, n))
+        n_chunks = -(-n // chunk)
+        pad = n_chunks * chunk - n
+        k = np.pad(keys, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 4)
+        m = np.pad(meta, (0, pad)).reshape(n_chunks, chunk)
+        v = np.pad(np.ones((n,), bool), (0, pad)).reshape(n_chunks, chunk)
+        self.table, ovf = _reinsert_chunks(
+            self.table, jnp.asarray(k), jnp.asarray(m), jnp.asarray(v),
+            max_probes=self.max_probes,
+        )
+        return int(np.asarray(ovf))
+
+    def _grow_target(self, need: int) -> int:
+        target = self.capacity
+        while need > self.grow_at * target:
+            target *= 2
+        return min(target, self.max_capacity)
+
+    def maybe_grow(self, incoming: int = 0) -> None:
+        """Grow-and-rehash when the upper-bound fill estimate (folded
+        inserts + in-flight lanes + the batch about to be submitted)
+        crosses ``grow_at`` × capacity. Cheap host arithmetic on the
+        common path; the exact device count is read only when the
+        estimate trips."""
+        if self.grow_at <= 0 or self.capacity >= self.max_capacity:
+            return
+        upper = self._table_fill + self._inflight_lanes + incoming
+        if upper <= self.grow_at * self.capacity:
+            return
+        self.complete_outstanding()  # grow must not strand dispatches
+        exact = self._table_fill_exact()
+        self._table_fill = exact
+        target = self._grow_target(exact + incoming)
+        if target > self.capacity:
+            self.grow(target)
+
+    def grow(self, new_capacity: int) -> None:
+        """Rebuild the table at ``new_capacity`` and re-hash every
+        occupied row (key home slots and probe chains depend on
+        capacity, so a raw row copy would be wrong — same reasoning as
+        the cross-topology checkpoint restore)."""
+        self.complete_outstanding()
+        t0 = time.perf_counter()
+        keys, meta = self._drain_table()
+        old_capacity = self.capacity
+        self.capacity = self._rebuild_table(new_capacity)
+        overflow = self._bulk_reinsert(keys, meta)
+        if overflow:
+            raise RuntimeError(
+                f"table grow lost {overflow} rows to probe overflow "
+                f"(capacity {self.capacity}); this indicates a "
+                "pathological key distribution"
+            )
+        self._table_fill = len(keys)
+        incr_counter("aggregator", "table_grow")
+        set_gauge("aggregator", "table_load",
+                  value=self._table_fill / self.capacity)
+        print(
+            f"table grown {old_capacity} → {self.capacity} slots "
+            f"({len(keys)} rows re-hashed in "
+            f"{time.perf_counter() - t0:.2f}s)",
+            file=sys.stderr,
         )
 
     # -- config ----------------------------------------------------------
@@ -283,6 +429,7 @@ class TpuAggregator:
                 else:
                     host_pos.append(start + j)
             if device_entries:
+                self.maybe_grow(incoming=len(device_entries))
                 batch = packing.pack_entries(
                     device_entries, batch_size=self.batch_size
                 )
@@ -338,6 +485,8 @@ class TpuAggregator:
             raise ValueError(
                 "host_data is required when data is a device array"
             )
+        self.maybe_grow(incoming=n)
+        self._inflight_lanes += n
         res = IngestResult(
             was_unknown=np.zeros((n,), bool),
             filtered=np.zeros((n,), bool),
@@ -423,6 +572,11 @@ class TpuAggregator:
         # per-entry Python only where bytes objects are genuinely needed
         # (serial materialization for PEM trees / the cross-encoding
         # guard — skipped entirely for count-only sinks).
+        # True table-fill delta: captured BEFORE the cross-encoding
+        # guard below flips any was_unknown lane for reporting — the
+        # device inserted those keys regardless, and the load-factor
+        # estimate must track slots, not report semantics.
+        dev_inserted = int(wu.sum())
         n = len(device_pos)
         pos_arr = np.asarray(device_pos, dtype=np.int64).reshape(n)
         if lane_of is None:
@@ -465,6 +619,9 @@ class TpuAggregator:
         dev_known = len(device_pos) - int(hl.sum()) - dev_unknown
         self.metrics["inserted"] += dev_unknown
         self.metrics["known"] += max(dev_known, 0)
+        self._table_fill += dev_inserted
+        set_gauge("aggregator", "table_load",
+                  value=self._table_fill / self.capacity)
         return host_pos
 
     def _host_lanes(self, host_pos, der_of, res) -> int:
@@ -795,6 +952,8 @@ class TpuAggregator:
             count=self._asarray(z["count"]),
         )
         self._device_written = bool(np.asarray(z["count"]).sum() > 0)
+        self._table_fill = int(np.asarray(z["count"]).sum())
+        self._inflight_lanes = 0
         self.capacity = int(z["keys"].shape[0])
         self.base_hour = int(z["base_hour"])
         self.registry = IssuerRegistry.from_json(z["registry"].tobytes().decode())

@@ -33,6 +33,8 @@ class ShardedAggregator(TpuAggregator):
         max_probes: int = 32,
         now: Optional[datetime] = None,
         dispatch_factor: float = 2.0,
+        grow_at: float = 0.7,
+        max_capacity: int = 1 << 28,
     ) -> None:
         self.mesh = mesh
         n = mesh.devices.size
@@ -52,6 +54,8 @@ class ShardedAggregator(TpuAggregator):
             cn_prefixes=cn_prefixes,
             max_probes=max_probes,
             now=now,
+            grow_at=grow_at,
+            max_capacity=max_capacity,
         )
 
     # -- hooks -----------------------------------------------------------
@@ -63,6 +67,22 @@ class ShardedAggregator(TpuAggregator):
 
     def _device_contains(self, fps: np.ndarray) -> np.ndarray:
         return self.dedup.contains_np(fps)
+
+    def _table_fill_exact(self) -> int:
+        return self.dedup.total_count()
+
+    def _rebuild_table(self, new_capacity: int) -> int:
+        self.dedup = ShardedDedup(
+            self.mesh,
+            capacity=self._mesh_capacity(new_capacity),
+            base_hour=self.base_hour,
+            max_probes=self.max_probes,
+            dispatch_factor=self.dedup.dispatch_factor,
+        )
+        return self.dedup.capacity
+
+    def _bulk_reinsert(self, keys: np.ndarray, meta: np.ndarray) -> int:
+        return self.dedup.bulk_insert_np(keys, meta)
 
     def _device_step_packed(self, batch):
         self._device_written = True

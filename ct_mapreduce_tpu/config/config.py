@@ -51,6 +51,8 @@ class CTConfig:
     backend: str = ""  # "", noop, localdisk, redis, tpu
     batch_size: int = 65536
     table_bits: int = 22  # dedup table slots = 2**table_bits per shard
+    table_grow_at: float = 0.7  # grow-and-rehash load factor; 0 disables
+    table_max_bits: int = 28  # growth ceiling; past it, spill to host lane
     mesh_shape: str = ""  # e.g. "data:4,expert:2"; empty = all devices on data
     device_queue_depth: int = 2
     agg_state_path: str = ""  # .npz snapshot of device aggregates (tpu backend)
@@ -82,6 +84,8 @@ class CTConfig:
         "backend": ("backend", str),
         "batchSize": ("batch_size", int),
         "tableBits": ("table_bits", int),
+        "tableGrowAt": ("table_grow_at", float),
+        "tableMaxBits": ("table_max_bits", int),
         "meshShape": ("mesh_shape", str),
         "deviceQueueDepth": ("device_queue_depth", int),
         "aggStatePath": ("agg_state_path", str),
@@ -225,6 +229,8 @@ class CTConfig:
             "backend = noop | localdisk | redis | tpu",
             "batchSize = device batch size (entries per dispatch)",
             "tableBits = log2 of dedup-table slots per shard",
+            "tableGrowAt = load factor that triggers grow-and-rehash (0 disables)",
+            "tableMaxBits = log2 growth ceiling; beyond it lanes spill to the exact host lane",
             "meshShape = device mesh, e.g. data:4,expert:2",
             "deviceQueueDepth = host->device prefetch depth",
             "aggStatePath = Path for the on-device aggregate snapshot (.npz)",

@@ -35,6 +35,8 @@ def build_aggregator(config: CTConfig, mesh=None) -> TpuAggregator:
         batch_size=config.batch_size,
         cn_prefixes=tuple(config.issuer_cn_filters()),
         now=now,
+        grow_at=config.table_grow_at,
+        max_capacity=1 << config.table_max_bits,
     )
     if mesh is None:
         spec = parse_mesh_shape(config.mesh_shape)

@@ -1,0 +1,110 @@
+"""Load-factor policy: grow-and-rehash with exact counts across growth.
+
+The reference's Redis table never fills (Redis grows; OOM is fatal,
+/root/reference/storage/rediscache.go:57-65). The HBM table DOES fill,
+and insert cost rises with load factor, so the aggregator grows to the
+next power of two at ``grow_at`` load (re-hashing every occupied row —
+home slots and probe chains depend on capacity) and spills to the exact
+host lane past ``max_capacity``. Either way the drained counts must
+stay exact.
+"""
+
+import datetime
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ct_mapreduce_tpu.agg import TpuAggregator
+from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
+from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
+from certgen import make_cert
+
+UTC = datetime.timezone.utc
+NOW = datetime.datetime(2024, 6, 1, tzinfo=UTC)
+
+
+def leaf(serial, issuer_cn="Grow CA", **kw):
+    kw.setdefault("is_ca", False)
+    kw.setdefault("subject_cn", f"g{serial}.example.com")
+    return make_cert(serial=serial, issuer_cn=issuer_cn, **kw)
+
+
+def entries(n, issuer_cn="Grow CA"):
+    ca = make_cert(issuer_cn=issuer_cn)
+    return [(leaf(7000 + i, issuer_cn=issuer_cn), ca) for i in range(n)]
+
+
+def test_auto_grow_preserves_dedup_and_counts():
+    sink = tmetrics.InMemSink()
+    tmetrics.set_sink(sink)
+    a = TpuAggregator(capacity=256, batch_size=64, now=NOW,
+                      grow_at=0.6, max_capacity=(1 << 12) + 7)
+    # A ragged ceiling rounds DOWN to a power of two at construction.
+    assert a.max_capacity == 1 << 12
+    ents = entries(300)
+    res = a.ingest(ents)
+    assert res.was_unknown.all()
+    # The policy grew the table (300 uniques ≫ 0.6 × 256) and kept it
+    # a power of two under the ceiling.
+    assert 256 < a.capacity <= 1 << 12
+    assert a.capacity & (a.capacity - 1) == 0
+    # Growth must never cost probe overflow into the host lane.
+    assert a.metrics["overflow"] == 0
+    # Device membership survived the re-hash: everything is now known.
+    res2 = a.ingest(ents)
+    assert not res2.was_unknown.any()
+    snap = a.drain()
+    assert snap.total == 300
+    # The gauge tracks fill/capacity.
+    load = sink.snapshot()["gauges"]["aggregator.table_load"]
+    assert 0 < load <= a.grow_at + 64 / a.capacity
+    tmetrics.set_sink(tmetrics.InMemSink())  # reset global for other tests
+
+
+def test_grow_disabled_spills_to_host_lane_exactly():
+    a = TpuAggregator(capacity=256, batch_size=64, now=NOW, grow_at=0)
+    ents = entries(300, issuer_cn="NoGrow CA")
+    res = a.ingest(ents)
+    assert a.capacity == 256  # never grew
+    assert res.was_unknown.all()  # host lane is exact for spilled lanes
+    assert a.metrics["host_lane"] > 0  # something really spilled
+    res2 = a.ingest(ents)
+    assert not res2.was_unknown.any()
+    assert a.drain().total == 300
+
+
+def test_max_capacity_caps_growth():
+    a = TpuAggregator(capacity=256, batch_size=64, now=NOW,
+                      grow_at=0.6, max_capacity=256)
+    ents = entries(300, issuer_cn="Capped CA")
+    a.ingest(ents)
+    assert a.capacity == 256
+    assert a.drain().total == 300
+
+
+def test_explicit_grow_rehashes_members():
+    a = TpuAggregator(capacity=1 << 10, batch_size=64, now=NOW)
+    ents = entries(100, issuer_cn="Explicit CA")
+    a.ingest(ents)
+    a.grow(1 << 12)
+    assert a.capacity == 1 << 12
+    res = a.ingest(ents)
+    assert not res.was_unknown.any()
+    assert a.drain().total == 100
+
+
+def test_sharded_grow_on_virtual_mesh():
+    devs = np.array(jax.devices()[:8])
+    assert devs.size == 8, "conftest must provide 8 virtual devices"
+    mesh = Mesh(devs, ("shard",))
+    a = ShardedAggregator(mesh, capacity=512, batch_size=64, now=NOW,
+                          grow_at=0.6, max_capacity=1 << 13)
+    ents = entries(400, issuer_cn="Sharded Grow CA")  # > 0.6 × 512
+    res = a.ingest(ents)
+    assert res.was_unknown.all()
+    assert a.capacity > 512
+    res2 = a.ingest(ents)
+    assert not res2.was_unknown.any()
+    assert a.drain().total == 400
