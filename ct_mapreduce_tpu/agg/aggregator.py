@@ -358,23 +358,44 @@ class TpuAggregator:
         if target > self.capacity:
             self.grow(target)
 
+    def _save_table_state(self):
+        return self.table
+
+    def _restore_table_state(self, saved) -> None:
+        self.table = saved
+
     def grow(self, new_capacity: int) -> None:
         """Rebuild the table at ``new_capacity`` and re-hash every
         occupied row (key home slots and probe chains depend on
         capacity, so a raw row copy would be wrong — same reasoning as
-        the cross-topology checkpoint restore)."""
+        the cross-topology checkpoint restore).
+
+        Crash-safe: the old table state is held until the reinsert
+        succeeds. A reinsert that probe-overflows (pathological /
+        adversarial key cluster) retries at double capacity up to the
+        ceiling; if it still overflows, the ORIGINAL state is restored
+        and the error raised — a caller that catches and continues
+        keeps exact counts either way."""
         self.complete_outstanding()
         t0 = time.perf_counter()
         keys, meta = self._drain_table()
         old_capacity = self.capacity
-        self.capacity = self._rebuild_table(new_capacity)
-        overflow = self._bulk_reinsert(keys, meta)
-        if overflow:
-            raise RuntimeError(
-                f"table grow lost {overflow} rows to probe overflow "
-                f"(capacity {self.capacity}); this indicates a "
-                "pathological key distribution"
-            )
+        saved = self._save_table_state()
+        cap = new_capacity
+        while True:
+            actual = self._rebuild_table(cap)
+            overflow = self._bulk_reinsert(keys, meta)
+            if not overflow:
+                break
+            if cap >= self.max_capacity:
+                self._restore_table_state(saved)
+                raise RuntimeError(
+                    f"table grow overflowed {overflow} rows even at the "
+                    f"max capacity {cap}; original table restored "
+                    "(pathological key distribution)"
+                )
+            cap = min(cap * 2, self.max_capacity)
+        self.capacity = actual
         self._table_fill = len(keys)
         incr_counter("aggregator", "table_grow")
         set_gauge("aggregator", "table_load",

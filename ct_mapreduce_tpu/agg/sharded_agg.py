@@ -71,6 +71,12 @@ class ShardedAggregator(TpuAggregator):
     def _table_fill_exact(self) -> int:
         return self.dedup.total_count()
 
+    def _save_table_state(self):
+        return self.dedup
+
+    def _restore_table_state(self, saved) -> None:
+        self.dedup = saved
+
     def _rebuild_table(self, new_capacity: int) -> int:
         self.dedup = ShardedDedup(
             self.mesh,
