@@ -586,6 +586,9 @@ class TpuAggregator:
         dropped = getattr(out, "dispatch_dropped", None)
         if dropped is not None:  # sharded path: routing-cap spill rate
             self.metrics["dispatch_spill"] += int(np.asarray(dropped).sum())
+        self.metrics["overflow"] += int(
+            np.asarray(out.probe_overflow).sum()
+        )
         self.issuer_totals += np.asarray(out.issuer_unknown_counts, np.int64)
 
         # Vectorized fold-in (the per-entry Python loop here was the e2e

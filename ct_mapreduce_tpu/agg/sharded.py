@@ -67,6 +67,8 @@ class ShardedStepOut(NamedTuple):
     crldp_len: jax.Array
     issuer_name_off: jax.Array
     issuer_name_len: jax.Array
+    probe_overflow: jax.Array  # bool[B] — shard-local insert exhausted
+    # its probe chain (spills to the exact host lane; `overflow` metric)
     dispatch_dropped: jax.Array  # bool[B] — lane spilled past the
     # per-(src,dst) routing cap to the exact host lane (surfaced as the
     # aggregator's `dispatch_spill` metric so routing skew is observable)
@@ -259,6 +261,7 @@ def _local_step(
             crldp_len=parsed.crldp_len,
             issuer_name_off=parsed.issuer_off,
             issuer_name_len=parsed.issuer_len,
+            probe_overflow=probe_overflow,
             dispatch_dropped=dispatch_dropped,
         ),
     )
@@ -353,7 +356,7 @@ class ShardedDedup:
                     issuer_unknown_counts=P(),
                     has_crldp=A, crldp_off=A, crldp_len=A,
                     issuer_name_off=A, issuer_name_len=A,
-                    dispatch_dropped=A,
+                    probe_overflow=A, dispatch_dropped=A,
                 ),
             ),
             check_vma=False,

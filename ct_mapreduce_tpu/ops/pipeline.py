@@ -46,6 +46,8 @@ class StepOut(NamedTuple):
     crldp_len: jax.Array  # int32[B]
     issuer_name_off: jax.Array  # int32[B] — issuer Name TLV window
     issuer_name_len: jax.Array  # int32[B]
+    probe_overflow: jax.Array  # bool[B] — insert exhausted its probe
+    # chain (spills to the exact host lane; `overflow` metric)
 
 
 def fingerprints(
@@ -241,6 +243,7 @@ def ingest_core(
     return table, StepOut(
         was_unknown=was_unknown,
         host_lane=host_lane,
+        probe_overflow=overflowed,
         filtered_ca=lanes.filtered_ca,
         filtered_expired=lanes.filtered_expired,
         filtered_cn=lanes.filtered_cn,

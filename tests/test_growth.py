@@ -54,6 +54,7 @@ def test_auto_grow_preserves_dedup_and_counts():
     # entry here is device-sized, so ANY host-lane traffic would mean
     # spilled probes).
     assert a.metrics["host_lane"] == 0
+    assert a.metrics["overflow"] == 0
     # Device membership survived the re-hash: everything is now known.
     res2 = a.ingest(ents)
     assert not res2.was_unknown.any()
@@ -72,6 +73,7 @@ def test_grow_disabled_spills_to_host_lane_exactly():
     assert a.capacity == 256  # never grew
     assert res.was_unknown.all()  # host lane is exact for spilled lanes
     assert a.metrics["host_lane"] > 0  # something really spilled
+    assert a.metrics["overflow"] > 0  # ... and the metric names the cause
     res2 = a.ingest(ents)
     assert not res2.was_unknown.any()
     assert a.drain().total == 300

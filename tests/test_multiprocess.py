@@ -78,7 +78,7 @@ _CHILD = textwrap.dedent("""
     mesh = Mesh(np.asarray(jax.devices()), (sharded.AXIS,))
     n = mesh.devices.size
     batch = 16 * n
-    data, length, issuer_idx, valid = ge._packed_batch(
+    data, length, issuer_idx, valid, _ = ge._packed_batch(
         batch, 1024, n_issuers=2)
     # Each process generated its own signing keys — broadcast proc 0's
     # batch so every controller feeds identical global values (the
