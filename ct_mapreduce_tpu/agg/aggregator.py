@@ -970,9 +970,10 @@ class TpuAggregator:
 
     def load_checkpoint(self, path: str) -> None:
         z = np.load(path, allow_pickle=True)
+        # Checkpoint format stays (keys, meta, count) for cross-version
+        # stability; the in-memory table fuses them into one row array.
         self.table = hashtable.TableState(
-            keys=self._asarray(z["keys"]),
-            meta=self._asarray(z["meta"]),
+            rows=self._asarray(hashtable.fuse_rows(z["keys"], z["meta"])),
             count=self._asarray(z["count"]),
         )
         self._device_written = bool(np.asarray(z["count"]).sum() > 0)
@@ -1014,8 +1015,7 @@ class HostSnapshotAggregator(TpuAggregator):
         if capacity & (capacity - 1):
             raise ValueError(f"capacity must be a power of two, got {capacity}")
         return hashtable.TableState(
-            keys=np.zeros((capacity, 4), np.uint32),
-            meta=np.zeros((capacity,), np.uint32),
+            rows=np.zeros((capacity, 5), np.uint32),
             count=np.zeros((), np.int32),
         )
 
@@ -1027,7 +1027,7 @@ class HostSnapshotAggregator(TpuAggregator):
 
     def _device_contains(self, fps: np.ndarray) -> np.ndarray:
         return hashtable.contains_np(
-            np.asarray(self.table.keys), fps, max_probes=self.max_probes
+            np.asarray(self.table.rows), fps, max_probes=self.max_probes
         )
 
     def _device_step_packed(self, batch):

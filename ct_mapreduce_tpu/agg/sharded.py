@@ -89,13 +89,13 @@ def _shard_of(keys: jax.Array, n_shards: int) -> jax.Array:
 
 @functools.partial(jax.jit, static_argnames=("n_shards", "max_probes"))
 def _contains_global(
-    table_keys: jax.Array, keys: jax.Array,
+    table_rows: jax.Array, keys: jax.Array,
     n_shards: int, max_probes: int,
 ) -> jax.Array:
     """Membership over the globally-viewed sharded table: shard-of-key
     addressing + the local triangular probe, as one gather-only jit (no
     shard_map — XLA inserts any needed collectives for the gathers)."""
-    capacity = table_keys.shape[0]
+    capacity = table_rows.shape[0]
     cap_loc = capacity // n_shards
     keys = hashtable._desentinel(keys.astype(jnp.uint32))
     dest = _shard_of(keys, n_shards)
@@ -112,7 +112,7 @@ def _contains_global(
         # Windowed early-exit scan (shared with hashtable.contains):
         # typically ONE table gather instead of max_probes of them.
         _slots, match_j, empty_j = hashtable._probe_window(
-            table_keys, keys, home, r, W, max_probes, cap_loc,
+            table_rows, keys, home, r, W, max_probes, cap_loc,
             slot_base=dest * cap_loc,
         )
         found = found | (open_ & jnp.any(
@@ -179,7 +179,7 @@ def _dispatch(
 
 
 def _local_step(
-    table_keys, table_meta, table_count,
+    table_rows, table_count,
     data, length, issuer_idx, valid,
     now_hour, base_hour, cn_prefixes, cn_prefix_lens,
     *, n_shards: int, cap: int, num_issuers: int, max_probes: int,
@@ -212,7 +212,7 @@ def _local_step(
     rk = recv.reshape(n_shards * cap, 5)
     rvalid = recv_valid.reshape(n_shards * cap)
     rkeys, rmeta = rk[:, :4], rk[:, 4]
-    state = hashtable.TableState(table_keys, table_meta, table_count)
+    state = hashtable.TableState(table_rows, table_count)
     state, r_unknown, r_overflow = hashtable.insert(
         state, rkeys, rmeta, rvalid, max_probes=max_probes
     )
@@ -245,7 +245,7 @@ def _local_step(
     )
 
     return (
-        state.keys, state.meta, state.count,
+        state.rows, state.count,
         ShardedStepOut(
             was_unknown=was_unknown,
             host_lane=host_lane,
@@ -306,10 +306,11 @@ class ShardedDedup:
         self.dispatch_factor = dispatch_factor
 
         row_sharded = NamedSharding(mesh, P(self.axis))
-        self.keys = jax.device_put(
-            jnp.zeros((capacity, 4), jnp.uint32), row_sharded
+        # Fused table rows (4 fp words + meta), row-sharded over the
+        # mesh — same layout as the single-chip TableState.
+        self.rows = jax.device_put(
+            jnp.zeros((capacity, 5), jnp.uint32), row_sharded
         )
-        self.meta = jax.device_put(jnp.zeros((capacity,), jnp.uint32), row_sharded)
         self.count = jax.device_put(
             jnp.zeros((self.n_shards,), jnp.int32), row_sharded
         )
@@ -342,12 +343,12 @@ class ShardedDedup:
             local,
             mesh=self.mesh,
             in_specs=(
-                A, A, A,  # table keys/meta/count
+                A, A,  # fused table rows + per-shard counts
                 A, A, A, A,  # batch
                 P(), P(), P(), P(),  # scalars + prefixes (replicated)
             ),
             out_specs=(
-                A, A, A,
+                A, A,
                 ShardedStepOut(
                     was_unknown=A, host_lane=A,
                     filtered_ca=A, filtered_expired=A,
@@ -361,7 +362,7 @@ class ShardedDedup:
             ),
             check_vma=False,
         )
-        fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
+        fn = jax.jit(mapped, donate_argnums=(0, 1))
         self._step_cache[key] = fn
         return fn
 
@@ -385,8 +386,8 @@ class ShardedDedup:
             jax.device_put(jnp.asarray(x), batch_sharding)
             for x in (data, length, issuer_idx, valid)
         ]
-        self.keys, self.meta, self.count, out = fn(
-            self.keys, self.meta, self.count,
+        self.rows, self.count, out = fn(
+            self.rows, self.count,
             *args,
             jnp.int32(now_hour), jnp.int32(self.base_hour),
             jnp.asarray(cn_prefixes), jnp.asarray(cn_prefix_lens),
@@ -399,24 +400,24 @@ class ShardedDedup:
         if fn is not None:
             return fn
 
-        def local(table_keys, table_meta, table_count, send, meta, valid):
-            state = hashtable.TableState(table_keys, table_meta, table_count)
+        def local(table_rows, table_count, send, meta, valid):
+            state = hashtable.TableState(table_rows, table_count)
             state, _, overflow = hashtable.insert(
                 state, send[0], meta[0], valid[0], max_probes=self.max_probes
             )
             return (
-                state.keys, state.meta, state.count,
+                state.rows, state.count,
                 jnp.sum(overflow, dtype=jnp.int32)[None],
             )
 
         mapped = jax.shard_map(
             local,
             mesh=self.mesh,
-            in_specs=tuple([P(self.axis)] * 6),
-            out_specs=tuple([P(self.axis)] * 4),
+            in_specs=tuple([P(self.axis)] * 5),
+            out_specs=tuple([P(self.axis)] * 3),
             check_vma=False,
         )
-        fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
+        fn = jax.jit(mapped, donate_argnums=(0, 1))
         self._step_cache[cache_key] = fn
         return fn
 
@@ -450,8 +451,8 @@ class ShardedDedup:
                 meta[i, : sl.size] = meta_np[sl]
                 valid[i, : sl.size] = True
             fn = self._bulk_insert_fn(width)
-            self.keys, self.meta, self.count, ovf = fn(
-                self.keys, self.meta, self.count,
+            self.rows, self.count, ovf = fn(
+                self.rows, self.count,
                 jax.device_put(jnp.asarray(send), batch_sharding),
                 jax.device_put(jnp.asarray(meta), batch_sharding),
                 jax.device_put(jnp.asarray(valid), batch_sharding),
@@ -473,11 +474,11 @@ class ShardedDedup:
         if fps_np.size == 0:
             return np.zeros((0,), bool)
         return np.asarray(_contains_global(
-            self.keys, jnp.asarray(fps_np.astype(np.uint32)),
+            self.rows, jnp.asarray(fps_np.astype(np.uint32)),
             n_shards=self.n_shards, max_probes=self.max_probes,
         ))
 
     def drain_np(self) -> tuple[np.ndarray, np.ndarray]:
         return hashtable.drain_np(
-            hashtable.TableState(self.keys, self.meta, self.count)
+            hashtable.TableState(self.rows, self.count)
         )

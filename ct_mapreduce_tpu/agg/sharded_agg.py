@@ -110,8 +110,7 @@ class ShardedAggregator(TpuAggregator):
 
         # Gather the sharded table to host once, reuse the parent format.
         self.table = hashtable.TableState(
-            keys=jnp.asarray(np.asarray(self.dedup.keys)),
-            meta=jnp.asarray(np.asarray(self.dedup.meta)),
+            rows=jnp.asarray(np.asarray(self.dedup.rows)),
             count=jnp.asarray(np.asarray(self.dedup.count)),
         )
         try:

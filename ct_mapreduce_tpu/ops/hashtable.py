@@ -44,7 +44,8 @@ not an occupied mismatch:
 
 Random-access ops (gather/scatter on the HBM-resident table) carry a
 large fixed per-op cost on TPU, so the structure minimizes OP COUNT
-per round (5 table-touching ops, no claim reset — a slot is contended
+per round (4 table-touching ops — the fused row commits key+meta in
+one scatter — and no claim reset: a slot is contended
 at most once per call) and ROUND COUNT (losers resolve in-round;
 windows cover W chain positions per gather).
 
@@ -103,20 +104,43 @@ PROBE_WIDTH = _probe_width_from_env()
 
 
 class TableState(NamedTuple):
-    """Dedup-set state living in HBM (donated through insert steps)."""
+    """Dedup-set state living in HBM (donated through insert steps).
 
-    keys: jax.Array  # uint32[capacity, 4]; all-zero row = empty
-    meta: jax.Array  # uint32[capacity]; packed (issuer_idx, exp_hour_offset)
+    One FUSED row per slot — 4 fingerprint words + the meta word —
+    so a winning lane commits key AND meta in a single scatter
+    (random-access table ops carry a large fixed cost on TPU; fusing
+    the two writes cuts insert from 5 table-touching ops per probe
+    round to 4). The all-zero KEY words mark an empty slot; meta of 0
+    is legal data.
+    """
+
+    rows: jax.Array  # uint32[capacity, 5]: fp words 0..3, meta word 4
     count: jax.Array  # int32[]; occupied slots
+
+    @property
+    def keys(self) -> jax.Array:  # uint32[capacity, 4] view
+        return self.rows[:, :4]
+
+    @property
+    def meta(self) -> jax.Array:  # uint32[capacity] view
+        return self.rows[:, 4]
 
 
 def make_table(capacity: int) -> TableState:
     if capacity & (capacity - 1):
         raise ValueError(f"capacity must be a power of two, got {capacity}")
     return TableState(
-        keys=jnp.zeros((capacity, 4), dtype=jnp.uint32),
-        meta=jnp.zeros((capacity,), dtype=jnp.uint32),
+        rows=jnp.zeros((capacity, 5), dtype=jnp.uint32),
         count=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def fuse_rows(keys, meta):
+    """uint32[N, 4] + uint32[N] → fused uint32[N, 5] rows (works on
+    NumPy and jax arrays alike)."""
+    xp = jnp if isinstance(keys, jax.Array) else np
+    return xp.concatenate(
+        [keys.astype(xp.uint32), meta.astype(xp.uint32)[:, None]], axis=1
     )
 
 
@@ -127,7 +151,7 @@ def _home_slot(keys: jax.Array, capacity: int) -> jax.Array:
 
 
 def _probe_window(
-    table_keys: jax.Array,
+    table_rows: jax.Array,
     keys: jax.Array,
     home: jax.Array,
     r: jax.Array,
@@ -140,6 +164,10 @@ def _probe_window(
     pattern of ``insert``, ``contains`` and the sharded membership scan
     (``slot_base`` offsets into a shard's row block).
 
+    ``table_rows`` is the fused uint32[capacity, 5] table (or any
+    row array whose first 4 words are the key); matching and the
+    empty-slot test look only at the key words.
+
     Returns ``(slots [B, W], match_j [B, W], empty_j [B, W])`` with
     positions past ``max_probes`` masked out of both match and empty.
     """
@@ -150,7 +178,7 @@ def _probe_window(
     else:
         slots = slot_base[:, None] + chain
     in_budget = rj < max_probes
-    cur = table_keys[slots]  # [B, W, 4]
+    cur = table_rows[slots][..., :4]  # [B, W, 4] key words of each row
     match_j = jnp.all(cur == keys[:, None, :], axis=-1) & in_budget
     empty_j = jnp.all(cur == 0, axis=-1) & in_budget
     return slots, match_j, empty_j
@@ -185,9 +213,10 @@ def insert(
     Returns:
       (new_state, was_unknown bool[B], overflowed bool[B]).
     """
-    capacity = state.keys.shape[0]
+    capacity = state.rows.shape[0]
     b = keys.shape[0]
     keys = _desentinel(keys.astype(jnp.uint32))
+    qrows = fuse_rows(keys, meta)  # [B, 5]: what a winner commits
     home = _home_slot(keys, capacity)
 
     lane = jnp.arange(b, dtype=jnp.int32)
@@ -200,16 +229,16 @@ def insert(
     max_rounds = max_probes + 1
 
     def cond(carry):
-        rounds, _r, _tk, _tm, _claim, pending, _found, _inserted, _ovf = carry
+        rounds, _r, _rows, _claim, pending, _found, _inserted, _ovf = carry
         return (rounds < max_rounds) & jnp.any(pending)
 
     def round_body(carry):
-        (rounds, r, table_keys, table_meta, claim,
+        (rounds, r, table_rows, claim,
          pending, found, inserted, ovf) = carry
         # Probe window: W consecutive triangular-chain positions
         # starting at each lane's r, fetched in ONE gather.
         slots, match_j, empty_j = _probe_window(
-            table_keys, keys, home, r, W, max_probes, capacity
+            table_rows, keys, home, r, W, max_probes, capacity
         )
         stop_j = match_j | empty_j
         any_stop = jnp.any(stop_j, axis=-1)
@@ -228,10 +257,12 @@ def insert(
         claim = claim.at[cslot].min(lane, mode="drop")
         wlane = claim[slot]  # winning lane id at each contested slot
         winner = empty & (wlane == lane)
-        # Winners hold unique slots: key/meta scatters see no duplicates.
+        # Winners hold unique slots, so this scatter sees no duplicate
+        # indices; the FUSED row commits key and meta in ONE op (the
+        # whole point of the fused layout — one fewer table-sized
+        # random-access op per round).
         wslot = jnp.where(winner, slot, capacity)
-        table_keys = table_keys.at[wslot].set(keys, mode="drop")
-        table_meta = table_meta.at[wslot].set(meta, mode="drop")
+        table_rows = table_rows.at[wslot].set(qrows, mode="drop")
         # Resolve election losers IN-ROUND (random-access ops have a
         # large fixed cost on TPU, so resolving here is far cheaper
         # than an extra round): the winner's key is keys[wlane] — a
@@ -253,7 +284,7 @@ def insert(
         exhausted = pending & (r >= max_probes)
         ovf = ovf | exhausted
         pending = pending & ~exhausted
-        return (rounds + 1, r, table_keys, table_meta, claim,
+        return (rounds + 1, r, table_rows, claim,
                 pending, found, inserted, ovf)
 
     pending0 = valid
@@ -266,10 +297,10 @@ def insert(
     # sharded per-shard reconstruction. Revisit only if profiles show
     # the fill on the flame graph.
     claim0 = jnp.full((capacity,), no_lane, dtype=jnp.int32)
-    (_, _, table_keys, table_meta, _, pending, found,
+    (_, _, table_rows, _, pending, found,
      inserted, ovf) = jax.lax.while_loop(
         cond, round_body,
-        (jnp.int32(0), r0, state.keys, state.meta, claim0,
+        (jnp.int32(0), r0, state.rows, claim0,
          pending0, zeros, zeros, zeros),
     )
 
@@ -279,7 +310,7 @@ def insert(
     # the exact host lane takes over.
     overflowed = ovf | pending
     new_count = state.count + jnp.sum(inserted, dtype=jnp.int32)
-    return TableState(table_keys, table_meta, new_count), was_unknown, overflowed
+    return TableState(table_rows, new_count), was_unknown, overflowed
 
 
 @functools.partial(jax.jit, static_argnames=("max_probes",))
@@ -291,7 +322,7 @@ def contains(state: TableState, keys: jax.Array, max_probes: int = 32) -> jax.Ar
     every lane has hit a match or an empty slot — the common case is
     ONE table gather, not ``max_probes`` of them (each random-access
     op costs ~5 ms on TPU regardless of batch width)."""
-    capacity = state.keys.shape[0]
+    capacity = state.rows.shape[0]
     keys = _desentinel(keys.astype(jnp.uint32))
     home = _home_slot(keys, capacity)
     b = keys.shape[0]
@@ -304,7 +335,7 @@ def contains(state: TableState, keys: jax.Array, max_probes: int = 32) -> jax.Ar
     def round_body(carry):
         r, found, open_ = carry
         _slots, match_j, empty_j = _probe_window(
-            state.keys, keys, home, r, W, max_probes, capacity
+            state.rows, keys, home, r, W, max_probes, capacity
         )
         found = found | (open_ & jnp.any(
             match_j & (jnp.cumsum(empty_j, axis=-1) == 0), axis=-1
@@ -324,16 +355,19 @@ def contains(state: TableState, keys: jax.Array, max_probes: int = 32) -> jax.Ar
     return found
 
 
-def contains_np(table_keys: np.ndarray, keys: np.ndarray,
+def contains_np(table_rows: np.ndarray, keys: np.ndarray,
                 max_probes: int = 32) -> np.ndarray:
     """NumPy mirror of :func:`contains` — same home slot, triangular
     chain, and match-before-first-empty invariant — for host-only
     snapshot reads (storage-statistics is pure host work and must not
     allocate device buffers or wait on TPU acquisition).
 
+    ``table_rows`` may be the fused [capacity, 5] rows or a bare
+    [capacity, 4] key array; only the key words are examined.
+
     Vectorized (drain probes every host-lane serial in one call), with
     the batch chunked to bound the [chunk, max_probes, 4] gather."""
-    capacity = table_keys.shape[0]
+    capacity = table_rows.shape[0]
     if capacity & (capacity - 1):
         raise ValueError(f"capacity must be a power of two, got {capacity}")
     keys = keys.astype(np.uint32, copy=True).reshape(-1, 4)
@@ -345,10 +379,11 @@ def contains_np(table_keys: np.ndarray, keys: np.ndarray,
     r = np.arange(max_probes, dtype=np.int64)
     tri = (r * (r + 1)) // 2
     out = np.zeros((keys.shape[0],), bool)
+    table_keys = table_rows[:, :4]  # zero-copy view; gather keys only
     for start in range(0, keys.shape[0], 65536):
         sl = slice(start, start + 65536)
         slots = (home[sl, None] + tri[None, :]) & mask  # [b, P]
-        rows = table_keys[slots]  # [b, P, 4]
+        rows = table_keys[slots]  # [b, P, 4] key words
         match = (rows == keys[sl, None, :]).all(axis=-1)
         empty = ~rows.any(axis=-1)
         out[sl] = (match & (np.cumsum(empty, axis=1) == 0)).any(axis=1)

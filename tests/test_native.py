@@ -154,20 +154,32 @@ def test_native_too_long_flagged():
     assert nat.length[0] == 0
 
 
+def _timed(fn):
+    import time
+
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 @pytest.mark.skipif(not available(), reason="no C++ compiler")
 def test_native_throughput_sanity():
     """The native path must beat per-entry Python decode comfortably."""
-    import time
-
     lis, eds, _, _ = _wire_batch()
     lis, eds = lis[:3] * 700, eds[:3] * 700  # 2100 entries
 
-    t0 = time.perf_counter()
+    # Best-of-3 each: on this one-core host a single bad scheduling
+    # slice under a loaded suite can flip a single-shot comparison.
+    t_native = min(
+        _timed(lambda: leafpack.decode_raw_batch(lis, eds, pad_len=2048))
+        for _ in range(3)
+    )
+    t_py = min(
+        _timed(lambda: leafpack._decode_python(lis, eds, pad_len=2048))
+        for _ in range(3)
+    )
     nat = leafpack.decode_raw_batch(lis, eds, pad_len=2048)
-    t_native = time.perf_counter() - t0
-    t0 = time.perf_counter()
     py = leafpack._decode_python(lis, eds, pad_len=2048)
-    t_py = time.perf_counter() - t0
     np.testing.assert_array_equal(nat.data, py.data)
     assert t_native < t_py, (t_native, t_py)
     print(f"native {2100/t_native:,.0f}/s vs python {2100/t_py:,.0f}/s")
