@@ -532,20 +532,51 @@ def run_e2e() -> dict:
 
     agg = TpuAggregator(capacity=capacity, batch_size=batch)
     sink = AggregatorSink(agg, flush_size=batch, device_queue_depth=2)
-    t0 = time.perf_counter()
-    t_prev = t0
-    for i, rb in enumerate(raw_batches):
-        sink.store_raw_batch(rb)
-        t_now = time.perf_counter()
-        log(f"e2e batch {i + 1}/{n_batches}: +{t_now - t_prev:.2f}s")
-        t_prev = t_now
-    sink.flush()
-    snap = agg.drain()
-    elapsed = time.perf_counter() - t0
+    # Phase-budget capture: a private metrics sink records the sink's
+    # decode/h2dSubmit/storeCertificate/completeBatch timers for JUST
+    # the timed replay, so the JSON carries a breakdown proving where
+    # the e2e wall time goes (decode vs submit vs device wait).
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
+    budget_sink = tmetrics.InMemSink()
+    prev_sink = tmetrics.get_sink()
+    tmetrics.set_sink(budget_sink)
+    try:
+        t0 = time.perf_counter()
+        t_prev = t0
+        for i, rb in enumerate(raw_batches):
+            sink.store_raw_batch(rb)
+            t_now = time.perf_counter()
+            log(f"e2e batch {i + 1}/{n_batches}: +{t_now - t_prev:.2f}s")
+            t_prev = t_now
+        sink.flush()
+        t_drain = time.perf_counter()
+        snap = agg.drain()
+        elapsed = time.perf_counter() - t0
+        drain_s = elapsed - (t_drain - t0)
+    finally:
+        tmetrics.set_sink(prev_sink)
     total = n_batches * batch
     rate = total / elapsed
+    samples = budget_sink.snapshot()["samples"]
+
+    def _sum(key: str) -> float:
+        return samples.get(f"ct-fetch.{key}", {}).get("sum", 0.0)
+
+    complete_s = _sum("completeBatch")
+    budget = {
+        "e2e_decode_s": round(_sum("decodeBatch"), 3),
+        "e2e_h2d_submit_s": round(_sum("h2dSubmit"), 3),
+        # storeCertificate wraps dispatch + the nested completeBatch
+        # waits; subtract to isolate pure submit cost.
+        "e2e_dispatch_s": round(
+            max(_sum("storeCertificate") - complete_s, 0.0), 3),
+        "e2e_device_wait_s": round(complete_s, 3),
+        "e2e_drain_s": round(drain_s, 3),
+    }
     log(f"e2e: {total} entries in {elapsed:.2f}s = {rate:,.0f} entries/s "
-        f"(drained total {snap.total})")
+        f"(drained total {snap.total}); budget: "
+        + ", ".join(f"{k[4:-2]}={v:.2f}s" for k, v in budget.items()))
     if snap.total != total:
         raise BenchError(
             f"e2e dedup mismatch: drained {snap.total} != fed {total}"
@@ -665,6 +696,7 @@ def run_e2e() -> dict:
     return {
         "e2e_entries_per_sec": round(rate, 1),
         "e2e_entries": total,
+        **budget,
     }
 
 

@@ -292,10 +292,11 @@ def insert(
     r0 = jnp.zeros((b,), jnp.int32)
     # Fresh capacity-sized claim scratch per call: a single ~4B/slot
     # broadcast fill (≈0.3 ms at 2^26 on v5e HBM, against a multi-ms
-    # step) buys an election that needs no persistent state — keeping
-    # TableState exactly (keys, meta, count) for checkpoints and the
-    # sharded per-shard reconstruction. Revisit only if profiles show
-    # the fill on the flame graph.
+    # step) buys an election that needs no persistent state — the
+    # persistent TableState stays just (rows, count), which the
+    # checkpoint codec splits back into keys/meta for format
+    # stability. Revisit only if profiles show the fill on the flame
+    # graph.
     claim0 = jnp.full((capacity,), no_lane, dtype=jnp.int32)
     (_, _, table_rows, _, pending, found,
      inserted, ovf) = jax.lax.while_loop(
