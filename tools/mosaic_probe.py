@@ -1,0 +1,389 @@
+"""Graduated Mosaic probe ladder for the Pallas DER-walker retry.
+
+Round 3 built a full Pallas walker that was parity-exact in interpret
+mode but crashed this environment's remote Mosaic compiler with no
+diagnostics (ARCHITECTURE.md "Performance engineering notes"); probe
+kernels with the same primitives compiled fine. This ladder makes the
+bisect repeatable: a sequence of kernels, each adding ONE construct on
+the road from "elementwise add" to "chained TLV walk", run in
+interpret mode (parity oracle) and then compiled on the real backend.
+The first stage that compiles interpreted-but-crashes-compiled names
+the guilty construct.
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/mosaic_probe.py --interpret
+      # interpret-mode run checked against pure-NumPy references
+  python tools/mosaic_probe.py    # on TPU: compile + parity vs interpret
+
+Prints one line per stage: PASS / FAIL(<error head>), and a final
+summary. Exit code 0 iff every attempted stage passed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+import numpy as np
+
+LANES = 128  # one register tile of lanes
+WORDS = 64  # 256-byte rows, word-packed like ops/der_kernel.py
+
+
+def _setup():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def _rows(seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 2**32, size=(WORDS, LANES), dtype=np.uint32)
+
+
+# --- stage bodies -------------------------------------------------------
+# Every kernel takes words[WORDS, LANES] (lanes on the 128-axis, the
+# layout the SHA kernel ships with) and writes out[1, LANES] int32.
+
+
+def _read_vec(w, off, clip=False):
+    """Shared one-hot byte read (big-endian word packing, the same
+    convention as ops/der_kernel.py): int32[LANES] byte values."""
+    import jax.numpy as jnp
+
+    if clip:
+        off = jnp.clip(off, 0, WORDS * 4 - 1)
+    widx = off // 4
+    sel = (jnp.arange(WORDS, dtype=jnp.int32)[:, None] == widx[None, :])
+    word = jnp.sum(jnp.where(sel, w, 0).astype(jnp.uint32), axis=0)
+    shift = (3 - (off % 4)) * 8
+    return ((word >> shift.astype(jnp.uint32)) & 0xFF).astype(jnp.int32)
+
+
+def _read_np(w, off, clip=False):
+    """NumPy mirror of _read_vec (the interpret-mode oracle)."""
+    off = np.asarray(off, np.int64)
+    if clip:
+        off = np.clip(off, 0, WORDS * 4 - 1)
+    word = w[off // 4, np.arange(LANES)]
+    shift = (3 - (off % 4)) * 8
+    return ((word >> shift.astype(np.uint32)) & 0xFF).astype(np.int32)
+
+
+def k_elementwise(w_ref, o_ref):
+    """Stage 0: pure elementwise + int32 sum — known-good baseline."""
+    import jax.numpy as jnp
+
+    w = w_ref[...].astype(jnp.int32)
+    o_ref[...] = jnp.sum(w & 0xFF, axis=0, keepdims=True)
+
+
+def k_onehot_read(w_ref, o_ref):
+    """Stage 1: ONE one-hot byte read at a fixed offset — the walker's
+    core primitive (word select × byte extract)."""
+    import jax.numpy as jnp
+
+    w = w_ref[...]
+    off = jnp.full((LANES,), 17, jnp.int32)  # byte offset per lane
+    widx = off // 4
+    sel = (jnp.arange(WORDS, dtype=jnp.int32)[:, None] == widx[None, :])
+    word = jnp.sum(jnp.where(sel, w, 0).astype(jnp.uint32), axis=0)
+    shift = (3 - (off % 4)) * 8
+    byte = (word >> shift.astype(jnp.uint32)) & 0xFF
+    o_ref[...] = byte.astype(jnp.int32)[None, :]
+
+
+def k_onehot_dyn(w_ref, o_ref):
+    """Stage 2: one-hot read at a DATA-DEPENDENT offset (offset derived
+    from row bytes — what a real TLV walk does)."""
+    import jax.numpy as jnp
+
+    w = w_ref[...]
+    first = (w[0] >> 24).astype(jnp.int32) % (WORDS * 4 - 4)
+    widx = first // 4
+    sel = (jnp.arange(WORDS, dtype=jnp.int32)[:, None] == widx[None, :])
+    word = jnp.sum(jnp.where(sel, w, 0).astype(jnp.uint32), axis=0)
+    shift = (3 - (first % 4)) * 8
+    o_ref[...] = ((word >> shift.astype(jnp.uint32)) & 0xFF).astype(
+        jnp.int32)[None, :]
+
+
+def k_fori_reads(w_ref, o_ref):
+    """Stage 3: 16 sequential one-hot reads in a fori_loop with a
+    carried per-lane offset (the walk loop skeleton)."""
+    import jax
+    import jax.numpy as jnp
+
+    w = w_ref[...]
+
+    def body(i, carry):
+        off, acc = carry
+        byte = _read_vec(w, off)
+        off = (off + 1 + (byte & 3)) % (WORDS * 4 - 4)
+        return off, acc + byte
+
+    off0 = jnp.zeros((LANES,), jnp.int32)
+    _, acc = jax.lax.fori_loop(0, 16, body, (off0, off0))
+    o_ref[...] = acc[None, :]
+
+
+def k_fori_masked(w_ref, o_ref):
+    """Stage 4: fori body containing a MASKED reduction — one of the
+    constructs round 3 suspected."""
+    import jax
+    import jax.numpy as jnp
+
+    w = w_ref[...]
+
+    def body(i, acc):
+        mask = (w >> 8) % 3 == i % 3
+        return acc + jnp.sum(
+            jnp.where(mask, w & 0xFF, 0).astype(jnp.int32), axis=0)
+
+    acc = jax.lax.fori_loop(0, 8, body, jnp.zeros((LANES,), jnp.int32))
+    o_ref[...] = acc[None, :]
+
+
+def k_uint_reduce(w_ref, o_ref):
+    """Stage 5: UNSIGNED-integer reduction (round 3: unsupported in
+    some forms; walker avoided it via int32 casts)."""
+    import jax.numpy as jnp
+
+    w = w_ref[...]
+    s = jnp.sum(w & jnp.uint32(0xFF), axis=0)  # uint32 reduction
+    o_ref[...] = s.astype(jnp.int32)[None, :]
+
+
+def k_while_early_exit(w_ref, o_ref):
+    """Stage 6: while_loop with an any()-based early exit — the
+    walker's scan loop shape."""
+    import jax
+    import jax.numpy as jnp
+
+    w = w_ref[...]
+    read = lambda off: _read_vec(w, off)  # noqa: E731
+
+    def cond(carry):
+        off, done, n = carry
+        return (~jnp.all(done)) & (n < 32)
+
+    def body(carry):
+        off, done, n = carry
+        byte = read(off)
+        done = done | (byte == 0)
+        off = jnp.where(done, off, (off + 1) % (WORDS * 4 - 4))
+        return off, done, n + 1
+
+    off0 = jnp.zeros((LANES,), jnp.int32)
+    off, _, _ = jax.lax.while_loop(
+        cond, body, (off0, jnp.zeros((LANES,), bool), jnp.int32(0)))
+    o_ref[...] = off[None, :]
+
+
+def k_tlv_step(w_ref, o_ref):
+    """Stage 7: one real TLV header decode — tag byte, short/long length
+    forms, offset advance (the walker's inner step, selects + shifts)."""
+    import jax.numpy as jnp
+
+    w = w_ref[...]
+    read = lambda off: _read_vec(w, off)  # noqa: E731
+
+    off = jnp.zeros((LANES,), jnp.int32)
+    l0 = read(off + 1)
+    long_form = l0 >= 0x80
+    nlen = jnp.where(long_form, l0 & 0x7F, 0)
+    l1 = read(off + 2)
+    l2 = read(off + 3)
+    content_len = jnp.where(
+        long_form,
+        jnp.where(nlen == 1, l1, l1 * 256 + l2),
+        l0,
+    )
+    hdr = 2 + jnp.where(long_form, nlen, 0)
+    o_ref[...] = jnp.clip(off + hdr + content_len, 0,
+                          WORDS * 4 - 1)[None, :]
+
+
+def k_tlv_walk(w_ref, o_ref):
+    """Stage 8: chained TLV walk — 12 header decodes in a fori_loop,
+    data-dependent offsets, the full walker shape in miniature."""
+    import jax
+    import jax.numpy as jnp
+
+    w = w_ref[...]
+    read = lambda off: _read_vec(w, off, clip=True)  # noqa: E731
+
+    def body(i, carry):
+        off, acc = carry
+        l0 = read(off + 1)
+        long_form = l0 >= 0x80
+        nlen = jnp.where(long_form, l0 & 0x7F, 0)
+        l1 = read(off + 2)
+        l2 = read(off + 3)
+        content = jnp.where(
+            long_form, jnp.where(nlen == 1, l1, l1 * 256 + l2), l0)
+        hdr = 2 + jnp.where(long_form, nlen, 0)
+        # Descend into constructed tags, skip primitives — both paths
+        # appear in the real walker.
+        tag = read(off)
+        constructed = (tag & 0x20) != 0
+        nxt = jnp.where(constructed, off + hdr, off + hdr + content)
+        nxt = nxt % (WORDS * 4 - 4)
+        return nxt, acc + (tag & 0xFF)
+
+    off0 = jnp.zeros((LANES,), jnp.int32)
+    off, acc = jax.lax.fori_loop(0, 12, body, (off0, off0))
+    o_ref[...] = (off + acc)[None, :]
+
+
+# --- NumPy references (the TRUE oracle; interpret mode is checked
+# against these, compiled mode against interpret) ------------------------
+
+
+def r_elementwise(w):
+    return (w & 0xFF).astype(np.int64).sum(0).astype(np.int32)[None, :]
+
+
+def r_onehot_read(w):
+    return _read_np(w, np.full((LANES,), 17))[None, :]
+
+
+def r_onehot_dyn(w):
+    first = (w[0] >> np.uint32(24)).astype(np.int32) % (WORDS * 4 - 4)
+    return _read_np(w, first)[None, :]
+
+
+def r_fori_reads(w):
+    off = np.zeros((LANES,), np.int64)
+    acc = np.zeros((LANES,), np.int64)
+    for _ in range(16):
+        byte = _read_np(w, off)
+        off = (off + 1 + (byte & 3)) % (WORDS * 4 - 4)
+        acc += byte
+    return acc.astype(np.int32)[None, :]
+
+
+def r_fori_masked(w):
+    acc = np.zeros((LANES,), np.int64)
+    for i in range(8):
+        mask = (w >> np.uint32(8)) % 3 == i % 3
+        acc += np.where(mask, w & 0xFF, 0).astype(np.int64).sum(0)
+    return acc.astype(np.int32)[None, :]
+
+
+def r_uint_reduce(w):
+    return (w & 0xFF).astype(np.int64).sum(0).astype(np.int32)[None, :]
+
+
+def r_while_early_exit(w):
+    off = np.zeros((LANES,), np.int64)
+    done = np.zeros((LANES,), bool)
+    for _ in range(32):
+        if done.all():
+            break
+        byte = _read_np(w, off)
+        done = done | (byte == 0)
+        off = np.where(done, off, (off + 1) % (WORDS * 4 - 4))
+    return off.astype(np.int32)[None, :]
+
+
+def _tlv_np(w, off):
+    l0 = _read_np(w, off + 1, clip=True)
+    long_form = l0 >= 0x80
+    nlen = np.where(long_form, l0 & 0x7F, 0)
+    l1 = _read_np(w, off + 2, clip=True)
+    l2 = _read_np(w, off + 3, clip=True)
+    content = np.where(long_form, np.where(nlen == 1, l1, l1 * 256 + l2), l0)
+    hdr = 2 + np.where(long_form, nlen, 0)
+    return content.astype(np.int64), hdr.astype(np.int64)
+
+
+def r_tlv_step(w):
+    off = np.zeros((LANES,), np.int64)
+    content, hdr = _tlv_np(w, off)
+    return np.clip(off + hdr + content, 0, WORDS * 4 - 1).astype(
+        np.int32)[None, :]
+
+
+def r_tlv_walk(w):
+    off = np.zeros((LANES,), np.int64)
+    acc = np.zeros((LANES,), np.int64)
+    for _ in range(12):
+        content, hdr = _tlv_np(w, off)
+        tag = _read_np(w, off, clip=True)
+        constructed = (tag & 0x20) != 0
+        nxt = np.where(constructed, off + hdr, off + hdr + content)
+        off = nxt % (WORDS * 4 - 4)
+        acc += tag
+    return (off + acc).astype(np.int32)[None, :]
+
+
+STAGES = [
+    ("0-elementwise", k_elementwise, r_elementwise),
+    ("1-onehot-fixed", k_onehot_read, r_onehot_read),
+    ("2-onehot-dynamic", k_onehot_dyn, r_onehot_dyn),
+    ("3-fori-reads", k_fori_reads, r_fori_reads),
+    ("4-fori-masked-reduce", k_fori_masked, r_fori_masked),
+    ("5-uint32-reduce", k_uint_reduce, r_uint_reduce),
+    ("6-while-early-exit", k_while_early_exit, r_while_early_exit),
+    ("7-tlv-header", k_tlv_step, r_tlv_step),
+    ("8-tlv-walk", k_tlv_walk, r_tlv_walk),
+]
+
+
+def run_stage(jax, name, kernel, ref_fn, interpret: bool):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    w = _rows()
+
+    def call(interp):
+        return pl.pallas_call(
+            kernel,
+            in_specs=[pl.BlockSpec((WORDS, LANES), lambda: (0, 0))],
+            out_specs=pl.BlockSpec((1, LANES), lambda: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+            interpret=interp,
+        )(jnp.asarray(w))
+
+    ref = np.asarray(call(True))
+    oracle = ref_fn(w)
+    if not np.array_equal(ref, oracle):
+        return False, "INTERPRET-WRONG (kernel disagrees with NumPy oracle)"
+    if interpret:
+        return True, "interpret matches NumPy oracle"
+    got = np.asarray(call(False))
+    if not np.array_equal(got, ref):
+        return False, "COMPILED-BUT-WRONG (parity mismatch vs interpret)"
+    return True, "compiled, parity exact"
+
+
+def main() -> int:
+    jax = _setup()
+    interpret = "--interpret" in sys.argv
+    backend = jax.default_backend()
+    print(f"backend: {backend}; mode: "
+          f"{'interpret parity' if interpret else 'compile + parity'}",
+          file=sys.stderr)
+    failures = []
+    for name, kernel, ref_fn in STAGES:
+        try:
+            ok, msg = run_stage(jax, name, kernel, ref_fn, interpret)
+        except Exception as err:  # noqa: BLE001 — report, keep probing
+            head = f"{type(err).__name__}: {err}".splitlines()[0][:160]
+            ok, msg = False, f"CRASH {head}"
+            if os.environ.get("CT_PROBE_VERBOSE"):
+                traceback.print_exc()
+        print(f"{'PASS' if ok else 'FAIL'} {name}: {msg}", flush=True)
+        if not ok:
+            failures.append(name)
+    print(f"{len(STAGES) - len(failures)}/{len(STAGES)} stages passed"
+          + (f"; first failure: {failures[0]}" if failures else ""))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
