@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-4 follow-up measurement ladder. The primary ladder
+# (tools/measure_ladder.sh) was already running when these tools were
+# built, and editing a live bash script corrupts its execution — so
+# this one WAITS for the primary's "ladder done" marker (or for the
+# pool if the primary isn't running) and then measures the round-4
+# additions:
+#   - load_sweep: insert throughput at 10/25/50/75% table load
+#   - mosaic_probe: the Pallas walker bisect ladder, compiled
+#   - CT_TPU_TESTS=1 hardware test tier (5 tests)
+#   - bench.py at 2^21 lanes (batch-width sweep past the 2^20 default)
+#   - PROBE_WIDTH=8 variant of the headline bench
+# Never SIGTERM a mid-claim python process; claims error on their own.
+#
+#   nohup tools/measure_ladder2.sh >/dev/null 2>&1 &
+#   tail -f /tmp/tpu_session2.log
+cd "$(dirname "$0")/.."
+log=${CT_LADDER2_LOG:-/tmp/tpu_session2.log}
+primary=${CT_LADDER_LOG:-/tmp/tpu_session.log}
+echo "=== ladder2 start $(date) ===" >> "$log"
+
+# Phase 1: wait for the primary ladder to finish (it holds the chip),
+# or — if it isn't running — for the pool itself.
+if pgrep -f measure_ladder.sh >/dev/null 2>&1; then
+  echo "waiting for primary ladder ($primary)" >> "$log"
+  while pgrep -f measure_ladder.sh >/dev/null 2>&1 \
+        && ! grep -q "=== ladder done" "$primary" 2>/dev/null; do
+    sleep 60
+  done
+  echo "primary done $(date)" >> "$log"
+else
+  while true; do
+    python tools/probe_pool.py >> "$log" 2>&1
+    if [ $? -eq 0 ]; then break; fi
+    echo "--- still down $(date) ---" >> "$log"
+    sleep 45
+  done
+fi
+
+echo "=== pool free $(date); running round-4 ladder ===" >> "$log"
+echo "--- load_sweep 24 ---" >> "$log"
+timeout 3000 python tools/load_sweep.py 24 0.10 0.25 0.50 0.75 >> "$log" 2>&1
+echo "--- mosaic_probe compiled ---" >> "$log"
+timeout 1800 python tools/mosaic_probe.py >> "$log" 2>&1
+echo "--- hardware test tier ---" >> "$log"
+CT_TPU_TESTS=1 timeout 2400 python -m pytest tests/test_tpu_hw.py -v >> "$log" 2>&1
+echo "--- bench 2^21 lanes ---" >> "$log"
+CT_BENCH_BATCH=2097152 CT_BENCH_WATCHDOG_SECS=520 CT_BENCH_E2E=0 \
+  timeout 1200 python bench.py >> "$log" 2>&1
+echo "--- bench default, PROBE_WIDTH=8 ---" >> "$log"
+CTMR_PROBE_WIDTH=8 CT_BENCH_WATCHDOG_SECS=520 CT_BENCH_E2E=0 \
+  timeout 1200 python bench.py >> "$log" 2>&1
+echo "--- bench default (fused rows, full e2e) ---" >> "$log"
+CT_BENCH_WATCHDOG_SECS=520 timeout 1200 python bench.py >> "$log" 2>&1
+echo "=== ladder2 done $(date) ===" >> "$log"
