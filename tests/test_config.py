@@ -60,13 +60,32 @@ def test_unparseable_values_keep_defaults(tmp_path):
 
 def test_tpu_directives(tmp_path):
     ini = tmp_path / "ct.ini"
-    ini.write_text("backend = tpu\nbatchSize = 131072\ntableBits = 24\n")
+    ini.write_text("backend = tpu\nbatchSize = 131072\ntableBits = 24\n"
+                   "tableGrowAt = 0.8\ntableMaxBits = 26\n")
     cfg = CTConfig.load(argv=["--config", str(ini)], env={})
     assert cfg.backend == "tpu"
     assert cfg.batch_size == 131072
     assert cfg.table_bits == 24
+    assert cfg.table_grow_at == 0.8
+    assert cfg.table_max_bits == 26
     cfg2 = CTConfig.load(argv=["--config", str(ini), "--backend", "redis"], env={})
     assert cfg2.backend == "redis"
+    # Env beats file; unparseable env falls back (config.go:41-123 quirk).
+    cfg3 = CTConfig.load(argv=["--config", str(ini)],
+                         env={"tableGrowAt": "0.5"})
+    assert cfg3.table_grow_at == 0.5
+    cfg4 = CTConfig.load(argv=["--config", str(ini)],
+                         env={"tableGrowAt": "banana"})
+    assert cfg4.table_grow_at == 0.8
+    # Growth disabled and ceilings flow into the aggregator factory.
+    from ct_mapreduce_tpu.models.ingest_model import build_aggregator
+
+    ini2 = tmp_path / "ct2.ini"
+    ini2.write_text("backend = tpu\ntableBits = 10\nmeshShape = shard:1\n"
+                    "tableGrowAt = 0\ntableMaxBits = 20\nbatchSize = 64\n")
+    agg = build_aggregator(CTConfig.load(argv=["--config", str(ini2)], env={}))
+    assert agg.grow_at == 0
+    assert agg.max_capacity == 1 << 20
 
 
 def test_usage_mentions_every_reference_directive():
