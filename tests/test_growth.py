@@ -112,3 +112,40 @@ def test_sharded_grow_on_virtual_mesh():
     res2 = a.ingest(ents)
     assert not res2.was_unknown.any()
     assert a.drain().total == 400
+
+
+def test_growth_survives_checkpoint_resume():
+    """Grow → snapshot → restore into a SMALLER-configured aggregator →
+    continue ingesting until it grows again: counts stay exact across
+    the whole life cycle (the checkpoint carries the grown capacity;
+    the restored table must keep growing from there)."""
+    import os
+    import tempfile
+
+    a = TpuAggregator(capacity=256, batch_size=64, now=NOW,
+                      grow_at=0.6, max_capacity=1 << 13)
+    first = entries(300, issuer_cn="Ckpt Grow CA")
+    a.ingest(first)
+    grown_cap = a.capacity
+    assert grown_cap > 256
+    fd, path = tempfile.mkstemp(suffix=".npz")
+    os.close(fd)
+    try:
+        a.save_checkpoint(path)
+
+        b = TpuAggregator(capacity=256, batch_size=64, now=NOW,
+                          grow_at=0.6, max_capacity=1 << 13)
+        b.load_checkpoint(path)
+        assert b.capacity == grown_cap  # checkpoint capacity wins
+        # Everything from before the restart is known.
+        res = b.ingest(first)
+        assert not res.was_unknown.any()
+        # Keep going until growth fires again on the restored table.
+        second = [(leaf(9000 + i, issuer_cn="Ckpt Grow CA"),
+                   first[0][1]) for i in range(400)]
+        res2 = b.ingest(second)
+        assert res2.was_unknown.all()
+        assert b.capacity > grown_cap
+        assert b.drain().total == 700
+    finally:
+        os.unlink(path)
