@@ -40,6 +40,30 @@ class ShardedAggregator(TpuAggregator):
         n = mesh.devices.size
         if batch_size % n:
             raise ValueError(f"batch_size {batch_size} must divide over {n} devices")
+        # Auto-growth is a LOCKSTEP operation (every process must
+        # rebuild + reinsert the same mesh-wide table at the same
+        # point), but its trigger derives from per-process fill
+        # estimates that diverge across hosts — a recipe for collective
+        # deadlock. Until a replicated trigger exists, growth is
+        # disabled when THIS mesh spans multiple processes (a
+        # process-local mesh inside a multi-host job keeps growing
+        # normally); probe overflow still spills to the exact host
+        # lane, so counts stay exact.
+        import jax
+
+        mesh_procs = {d.process_index for d in mesh.devices.flat}
+        if grow_at > 0 and len(mesh_procs) > 1:
+            if jax.process_index() == min(mesh_procs):
+                import sys
+
+                print(
+                    "ShardedAggregator: disabling table auto-growth — "
+                    f"the mesh spans {len(mesh_procs)} processes; size "
+                    "tableBits for the full run or re-shard via "
+                    "checkpoint",
+                    file=sys.stderr,
+                )
+            grow_at = 0.0
         self.dedup = ShardedDedup(
             mesh,
             capacity=capacity,

@@ -105,6 +105,15 @@ _CHILD = textwrap.dedent("""
                       now_hour=ge._NOW_HOUR)
     assert dedup.total_count() == total  # replay inserted nothing
     print(f"proc{pid}: sharded step OK total={total}", flush=True)
+
+    # Auto-growth must be forced OFF under multi-host: its trigger is
+    # per-process and would fire out of lockstep (collective deadlock).
+    from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
+
+    agg = ShardedAggregator(mesh, capacity=1024 * n, batch_size=batch,
+                            grow_at=0.7)
+    assert agg.grow_at == 0, agg.grow_at
+    print(f"proc{pid}: multi-host growth guard OK", flush=True)
 """)
 
 
