@@ -44,6 +44,39 @@ def _rows(seed: int = 0) -> np.ndarray:
     return rng.integers(0, 2**32, size=(WORDS, LANES), dtype=np.uint32)
 
 
+def _cert_rows() -> tuple[np.ndarray, np.ndarray]:
+    """Word-pack LANES real DER certificates (one per lane) and return
+    (rows uint32[WORDS, LANES], expected int32[1, LANES]) where
+    expected mirrors stage 9's checksum via the exact host parser."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for path in (repo, os.path.join(repo, "tests")):
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    from certgen import make_cert  # tests fixture generator
+
+    from ct_mapreduce_tpu.core import der as hostder
+
+    rows = np.zeros((WORDS, LANES), np.uint32)
+    expected = np.zeros((LANES,), np.int32)
+    for i in range(LANES):
+        # Serial lengths 1..20 bytes incl. leading-zero cases.
+        serial = int.from_bytes(
+            bytes([(i % 19) + 1]) * ((i % 20) + 1), "big")
+        der = make_cert(serial=serial, is_ca=False,
+                        subject_cn=f"probe{i}.example.com")
+        padded = der[: WORDS * 4].ljust(WORDS * 4, b"\x00")
+        w = np.frombuffer(padded, np.uint8).reshape(WORDS, 4)
+        rows[:, i] = (
+            (w[:, 0].astype(np.uint32) << 24)
+            | (w[:, 1].astype(np.uint32) << 16)
+            | (w[:, 2].astype(np.uint32) << 8)
+            | w[:, 3].astype(np.uint32)
+        )
+        f = hostder.parse_cert(der)
+        expected[i] = sum(f.serial) + len(f.serial) * 1000
+    return rows, expected[None, :]
+
+
 # --- stage bodies -------------------------------------------------------
 # Every kernel takes words[WORDS, LANES] (lanes on the 128-axis, the
 # layout the SHA kernel ships with) and writes out[1, LANES] int32.
@@ -321,6 +354,53 @@ def r_tlv_walk(w):
     return (off + acc).astype(np.int32)[None, :]
 
 
+def k_serial_extract(w_ref, o_ref):
+    """Stage 9: REAL walker fragment — serial extraction from genuine
+    DER certificates: three nested TLV header decodes (cert SEQUENCE →
+    TBS SEQUENCE → optional [0] version → serial INTEGER), then a
+    masked byte-sum over the serial content window. Combines every
+    suspected construct on real data."""
+    import jax.numpy as jnp
+
+    w = w_ref[...]
+    read = lambda off: _read_vec(w, off, clip=True)  # noqa: E731
+
+    def hdr(off):
+        l0 = read(off + 1)
+        long_form = l0 >= 0x80
+        nlen = jnp.where(long_form, l0 & 0x7F, 0)
+        l1 = read(off + 2)
+        l2 = read(off + 3)
+        content = jnp.where(
+            long_form, jnp.where(nlen == 1, l1, l1 * 256 + l2), l0)
+        return read(off), 2 + jnp.where(long_form, nlen, 0), content
+
+    # cert SEQUENCE → TBS SEQUENCE → (maybe [0] version) → serial
+    _tag0, h0, _c0 = hdr(jnp.zeros((LANES,), jnp.int32))
+    tbs_off = h0
+    _tag1, h1, _c1 = hdr(tbs_off)
+    el = tbs_off + h1
+    tag_e, h_e, c_e = hdr(el)
+    has_version = tag_e == 0xA0
+    ser_el = jnp.where(has_version, el + h_e + c_e, el)
+    tag_s, h_s, c_s = hdr(ser_el)
+    ser_off = ser_el + h_s
+    ser_len = c_s
+    # Masked byte-sum over [ser_off, ser_off+ser_len): unpack every
+    # word into its 4 bytes via shifts (vector work), mask on a byte-
+    # position iota, reduce.
+    pos_w = jnp.arange(WORDS, dtype=jnp.int32)[:, None]  # word index
+    total = jnp.zeros((LANES,), jnp.int32)
+    for k in range(4):
+        byte = ((w >> jnp.uint32((3 - k) * 8)) & 0xFF).astype(jnp.int32)
+        bpos = pos_w * 4 + k  # [WORDS, 1] byte position
+        mask = (bpos >= ser_off[None, :]) & (bpos < (ser_off + ser_len)[None, :])
+        total = total + jnp.sum(jnp.where(mask, byte, 0), axis=0)
+    ok = (tag_s == 0x02).astype(jnp.int32)
+    o_ref[...] = (total * ok + ser_len * 1000 * ok)[None, :]
+
+
+
 STAGES = [
     ("0-elementwise", k_elementwise, r_elementwise),
     ("1-onehot-fixed", k_onehot_read, r_onehot_read),
@@ -331,6 +411,7 @@ STAGES = [
     ("6-while-early-exit", k_while_early_exit, r_while_early_exit),
     ("7-tlv-header", k_tlv_step, r_tlv_step),
     ("8-tlv-walk", k_tlv_walk, r_tlv_walk),
+    ("9-serial-extract", k_serial_extract, None),  # oracle: host parser
 ]
 
 
@@ -338,7 +419,11 @@ def run_stage(jax, name, kernel, ref_fn, interpret: bool):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    w = _rows()
+    if ref_fn is None:  # stage 9: real certs, host-parser oracle
+        w, oracle_out = _cert_rows()
+        ref_fn = lambda _w: oracle_out  # noqa: E731
+    else:
+        w = _rows()
 
     def call(interp):
         return pl.pallas_call(
