@@ -446,6 +446,62 @@ def run_stage(jax, name, kernel, ref_fn, interpret: bool):
     return True, "compiled, parity exact"
 
 
+def time_stage9(jax) -> None:
+    """Compiled throughput of the stage-9 walker fragment at 2^20
+    lanes: the number that validates (or kills) the ~0.06 µs/entry
+    Pallas-walker projection in ARCHITECTURE's roofline table.
+
+    Platform rules apply: many kernel invocations inside ONE jitted
+    fori_loop execution, one synchronous readback. The loop carry
+    mutates one lane's words per iteration so XLA cannot hoist the
+    loop-invariant call."""
+    import time
+
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    G = int(os.environ.get("CT_PROBE_TIME_TILES", "8192"))  # 2^20 lanes
+    reps = int(os.environ.get("CT_PROBE_TIME_REPS", "32"))
+    w, _ = _cert_rows()
+    big = np.tile(w, (1, G))
+
+    # CT_PROBE_TIME_INTERPRET=1: run the harness under the interpreter
+    # (CPU smoke of the timing plumbing; meaningless as a measurement).
+    interp = os.environ.get("CT_PROBE_TIME_INTERPRET") == "1"
+    fn = pl.pallas_call(
+        k_serial_extract,
+        grid=(G,),
+        in_specs=[pl.BlockSpec((WORDS, LANES), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((1, LANES), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, G * LANES), jnp.int32),
+        interpret=interp,
+    )
+
+    @jax.jit
+    def run(big):
+        def body(i, carry):
+            big, acc = carry
+            big = big.at[:, :1].set(
+                jnp.broadcast_to(i.astype(jnp.uint32), (WORDS, 1)))
+            return big, acc + fn(big)[0, LANES]
+        _, acc = jax.lax.fori_loop(
+            0, reps, body, (big, jnp.int32(0)))
+        return acc
+
+    dev_big = jax.device_put(jnp.asarray(big))
+    t0 = time.perf_counter()
+    int(run(dev_big))  # compile + warm
+    print(f"stage-9 timing: compile+warm {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    int(run(dev_big))
+    dt = time.perf_counter() - t0
+    lanes = G * LANES * reps
+    print(f"stage-9 serial-extract: {dt:.3f}s for {lanes} lanes = "
+          f"{dt / lanes * 1e9:.1f} ns/lane "
+          f"({lanes / dt / 1e6:.2f}M lanes/s)", flush=True)
+
+
 def main() -> int:
     jax = _setup()
     interpret = "--interpret" in sys.argv
@@ -467,6 +523,12 @@ def main() -> int:
             failures.append(name)
     print(f"{len(STAGES) - len(failures)}/{len(STAGES)} stages passed"
           + (f"; first failure: {failures[0]}" if failures else ""))
+    if not interpret and backend == "tpu" and "9-serial-extract" not in failures:
+        try:
+            time_stage9(jax)
+        except Exception as err:  # noqa: BLE001 — timing is best-effort
+            print(f"stage-9 timing failed: "
+                  f"{type(err).__name__}: {err}"[:200], file=sys.stderr)
     return 1 if failures else 0
 
 
