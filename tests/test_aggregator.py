@@ -266,3 +266,56 @@ def test_cn_prefix_filter_through_aggregator():
     assert list(res.was_unknown) == [True, False]
     assert list(res.filtered) == [False, True]
     assert a.metrics["filtered_cn"] == 1
+
+
+def test_rsa_certificates_device_path():
+    """RSA certs (the dominant real-CT key type): ~270-byte SPKI and a
+    different AlgorithmIdentifier shape than every ECDSA fixture in
+    this suite. The device walker must extract the same identity the
+    host parser does, including a 20-byte serial (RFC 5280 maximum)."""
+    import datetime as dt
+
+    from cryptography import x509 as cx509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    now = dt.datetime(2024, 1, 1, tzinfo=UTC)
+
+    def build(cn, serial, ca):
+        name = cx509.Name([cx509.NameAttribute(NameOID.COMMON_NAME, cn)])
+        issuer = cx509.Name(
+            [cx509.NameAttribute(NameOID.COMMON_NAME, "RSA Agg CA")])
+        b = (cx509.CertificateBuilder()
+             .subject_name(name).issuer_name(issuer)
+             .public_key(key.public_key())
+             .serial_number(serial)
+             .not_valid_before(now)
+             .not_valid_after(now + dt.timedelta(days=900))
+             .add_extension(cx509.BasicConstraints(
+                 ca=ca, path_length=None), critical=True))
+        return b.sign(key, hashes.SHA256()).public_bytes(
+            serialization.Encoding.DER)
+
+    ca_der = build("RSA Agg CA", 1, True)
+    max_serial = int.from_bytes(b"\x7f" + b"\xab" * 19, "big")  # 20 bytes
+    leaves = [build(f"rsa{i}.example.com", max_serial - i, False)
+              for i in range(4)]
+
+    a = agg()
+    res = a.ingest([(l, ca_der) for l in leaves])
+    assert res.was_unknown.all()
+    assert not res.filtered.any()
+    res2 = a.ingest([(l, ca_der) for l in leaves])
+    assert not res2.was_unknown.any()
+    snap = a.drain()
+    assert snap.total == 4
+
+    # Identity ground truth straight from cryptography, not our parser.
+    spki = key.public_key().public_bytes(
+        serialization.Encoding.DER,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    assert snap.issuers() == [Issuer.from_spki(spki).id()]
+    # Serial bytes: raw DER integer encoding (leading 0x7f, 20 bytes).
+    assert res.serials[0] == (b"\x7f" + b"\xab" * 19)
