@@ -186,26 +186,22 @@ def _window(rows: _Rows, p: jax.Array, n_words: int):
             ),
             axis=1,
         )
-        sup = jnp.concatenate([lo, hi], axis=1)  # uint32[B, 2A]
+        src = jnp.concatenate([lo, hi], axis=1)  # uint32[B, 2A]
         loc = base - bi * A  # superblock word position, in [0, A)
-        iota_a = jax.lax.broadcasted_iota(jnp.int32, (b, A), 1)
-        oh = iota_a == loc[:, None]
-        words = [
-            jnp.sum(jnp.where(oh, sup[:, k : k + A], jnp.uint32(0)), axis=1)
-            for k in range(n_words)
-        ]
+        oh = jax.lax.broadcasted_iota(jnp.int32, (b, A), 1) == loc[:, None]
+        width = A
     else:
         # Flat one-hot over the whole row — cheapest for short rows.
         # XLA fuses the iota comparison into the reduction, so each
         # word read streams only the word slice (exact by construction
         # — no dot, no floating point).
-        iota = jax.lax.broadcasted_iota(jnp.int32, (b, nw), 1)
-        oh = iota == base[:, None]
-        words = [
-            jnp.sum(jnp.where(oh, rows.words[:, k : k + nw], jnp.uint32(0)),
-                    axis=1)
-            for k in range(n_words)
-        ]
+        src = rows.words
+        oh = jax.lax.broadcasted_iota(jnp.int32, (b, nw), 1) == base[:, None]
+        width = nw
+    words = [
+        jnp.sum(jnp.where(oh, src[:, k : k + width], jnp.uint32(0)), axis=1)
+        for k in range(n_words)
+    ]
     ww = jnp.stack(words, axis=1)  # uint32[B, n_words]
     win = jnp.stack(
         [(ww >> 24) & 0xFF, (ww >> 16) & 0xFF, (ww >> 8) & 0xFF, ww & 0xFF],
@@ -449,23 +445,31 @@ def _scan_extensions(rows: _Rows, ext_off, ext_end, alive0):
     return is_ca, has_crldp, dp_off, dp_len, alive & ~exhausted
 
 
-@jax.jit
-def parse_certs(data: jax.Array, length: jax.Array) -> ParsedCerts:
+@functools.partial(jax.jit, static_argnames=("scan_issuer_cn",))
+def parse_certs(
+    data: jax.Array, length: jax.Array, scan_issuer_cn: bool = True
+) -> ParsedCerts:
     """Extract map-stage fields from a batch of DER certificates.
 
     Args:
       data: uint8[B, L] zero-padded DER.
       length: int32[B] true byte length per lane.
+      scan_issuer_cn: static — False skips the RDN scan entirely
+        (several window reads per round); callers with no CN-prefix
+        filter configured pass False and get cn_off/cn_len of 0.
 
     Returns a :class:`ParsedCerts`; lanes with ``ok=False`` must be
     re-parsed on the host (reference lane).
     """
     return parse_certs_rows(
-        _pack_rows(data.astype(jnp.uint8)), length.astype(jnp.int32)
+        _pack_rows(data.astype(jnp.uint8)), length.astype(jnp.int32),
+        scan_issuer_cn=scan_issuer_cn,
     )
 
 
-def parse_certs_rows(rows: _Rows, length: jax.Array) -> ParsedCerts:
+def parse_certs_rows(
+    rows: _Rows, length: jax.Array, scan_issuer_cn: bool = True
+) -> ParsedCerts:
     """:func:`parse_certs` over pre-packed rows — callers that also
     extract serials (the fused ingest step) pack once and share."""
     length = length.astype(jnp.int32)
@@ -511,7 +515,10 @@ def parse_certs_rows(rows: _Rows, length: jax.Array) -> ParsedCerts:
     issuer_len_out = hlen + clen
     issuer_inner = p + hlen
     issuer_end = p + hlen + clen
-    cn_off, cn_len = _scan_issuer_cn(rows, issuer_inner, issuer_end, ok)
+    if scan_issuer_cn:
+        cn_off, cn_len = _scan_issuer_cn(rows, issuer_inner, issuer_end, ok)
+    else:  # CN filter disabled (static) — skip the RDN scan entirely
+        cn_off = cn_len = jnp.zeros((b,), jnp.int32)
     p = issuer_end
 
     # validity SEQUENCE { notBefore, notAfter } — one window covers the

@@ -143,7 +143,11 @@ def local_lanes(
     serial extraction, and the CN window — one pass over [B, L], not
     three (der_kernel's gather-free access path)."""
     rows = der_kernel.pack_rows(data)
-    parsed = der_kernel.parse_certs_rows(rows, length)
+    # The RDN scan only feeds the CN-prefix filter; with no prefixes
+    # configured (static shape) it is dead work — skip it at trace time.
+    parsed = der_kernel.parse_certs_rows(
+        rows, length, scan_issuer_cn=cn_prefixes.shape[0] > 0
+    )
     ok = parsed.ok & valid
 
     serials, fits = der_kernel.gather_serials_rows(

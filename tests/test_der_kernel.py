@@ -72,6 +72,23 @@ def test_parity_with_host_lane():
         assert cn == ref.issuer_cn, i
 
 
+def test_cn_scan_gated_off():
+    """scan_issuer_cn=False (no CN filter configured) must zero ONLY
+    the cn fields; every other extracted field stays identical."""
+    ders = fixture_certs()
+    data, length = pack(ders)
+    full = der_kernel.parse_certs(data, length)
+    gated = der_kernel.parse_certs(data, length, scan_issuer_cn=False)
+    assert not np.any(np.asarray(gated.issuer_cn_off))
+    assert not np.any(np.asarray(gated.issuer_cn_len))
+    for field in full._fields:
+        if field.startswith("issuer_cn_"):
+            continue
+        assert np.array_equal(
+            np.asarray(getattr(full, field)), np.asarray(getattr(gated, field))
+        ), field
+
+
 def test_serial_gather():
     ders = fixture_certs()
     data, length = pack(ders)
