@@ -64,6 +64,9 @@ _PAD_WORDS = 13  # slack words so shifted slices cover every window
 
 _BLOCK_WORDS = 16  # two-level window: block granularity (see _window)
 
+_SUP_BLOCKS = 8  # superblock loops: blocks fetched per scan round
+# (512 bytes — covers a typical whole extension list in ONE row pass)
+
 
 class ParsedCerts(NamedTuple):
     """Per-lane extraction results (int32 unless noted)."""
@@ -175,21 +178,8 @@ def _window(rows: _Rows, p: jax.Array, n_words: int):
         K = -(-nw // A)
         blk = rows.words[:, : K * A].reshape(b, K, A)
         bi = base // A
-        iota_k = jax.lax.broadcasted_iota(jnp.int32, (b, K), 1)
-        lo = jnp.sum(
-            jnp.where((iota_k == bi[:, None])[:, :, None], blk, jnp.uint32(0)),
-            axis=1,
-        )
-        hi = jnp.sum(
-            jnp.where(
-                (iota_k == bi[:, None] + 1)[:, :, None], blk, jnp.uint32(0)
-            ),
-            axis=1,
-        )
-        src = jnp.concatenate([lo, hi], axis=1)  # uint32[B, 2A]
-        loc = base - bi * A  # superblock word position, in [0, A)
-        oh = jax.lax.broadcasted_iota(jnp.int32, (b, A), 1) == loc[:, None]
-        width = A
+        words = _two_level_words(blk, bi, base - bi * A, n_words)
+        return _words_to_bytes(words), (jnp.maximum(p, 0) & 3)
     else:
         # Flat one-hot over the whole row — cheapest for short rows.
         # XLA fuses the iota comparison into the reduction, so each
@@ -202,12 +192,96 @@ def _window(rows: _Rows, p: jax.Array, n_words: int):
         jnp.sum(jnp.where(oh, src[:, k : k + width], jnp.uint32(0)), axis=1)
         for k in range(n_words)
     ]
+    return _words_to_bytes(words), (jnp.maximum(p, 0) & 3)
+
+
+def _two_level_words(
+    blocks: jax.Array, bi: jax.Array, loc: jax.Array, n_words: int
+) -> list[jax.Array]:
+    """Two-level word-window select shared by :func:`_window` and
+    :func:`_sup_window`: one-hot blocks ``bi`` and ``bi+1`` out of
+    ``blocks`` uint32[B, K, A] (one fused pass, two tiny outputs),
+    then the shifted-slice select of ``n_words`` words at word offset
+    ``loc`` ∈ [0, A) within the 2A-word pair. ``bi+1 == K`` one-hots
+    to an all-zero block (callers rely on it matching zero padding).
+    Requires ``loc + n_words <= 2A`` (``n_words <= A + 1`` given
+    ``loc < A`` — enforced by _window's _BLOCK_WORDS guard).
+    """
+    b, _k, A = blocks.shape
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (b, blocks.shape[1]), 1)
+    lo = jnp.sum(
+        jnp.where((iota_k == bi[:, None])[:, :, None], blocks, jnp.uint32(0)),
+        axis=1,
+    )
+    hi = jnp.sum(
+        jnp.where(
+            (iota_k == bi[:, None] + 1)[:, :, None], blocks, jnp.uint32(0)
+        ),
+        axis=1,
+    )
+    pair = jnp.concatenate([lo, hi], axis=1)  # uint32[B, 2A]
+    oh = jax.lax.broadcasted_iota(jnp.int32, (b, A), 1) == loc[:, None]
+    return [
+        jnp.sum(jnp.where(oh, pair[:, k : k + A], jnp.uint32(0)), axis=1)
+        for k in range(n_words)
+    ]
+
+
+def _words_to_bytes(words: list[jax.Array]) -> jax.Array:
+    """n_words per-lane uint32 words → int32[B, n_words*4] byte window."""
     ww = jnp.stack(words, axis=1)  # uint32[B, n_words]
-    win = jnp.stack(
+    return jnp.stack(
         [(ww >> 24) & 0xFF, (ww >> 16) & 0xFF, (ww >> 8) & 0xFF, ww & 0xFF],
         axis=2,
-    ).reshape(p.shape[0], n_words * 4).astype(jnp.int32)
-    return win, (jnp.maximum(p, 0) & 3)
+    ).reshape(ww.shape[0], len(words) * 4).astype(jnp.int32)
+
+
+def _sup_fetch(rows: _Rows, bi0: jax.Array) -> jax.Array:
+    """Fetch a per-lane SUPERBLOCK: ``_SUP_BLOCKS`` consecutive
+    ``_BLOCK_WORDS``-word blocks anchored at block index ``bi0``
+    (superblock word ``j`` = row word ``bi0*_BLOCK_WORDS + j``).
+
+    ONE fused pass over the row produces all ``_SUP_BLOCKS`` outputs —
+    this is what lets the variable-count scans pay ~one HBM row pass
+    per ~468 bytes of scanned region instead of one per TLV element.
+    Blocks past the padded row one-hot to zero, matching the zero
+    padding the flat path reads there.
+    """
+    b = bi0.shape[0]
+    A = _BLOCK_WORDS
+    K = -(-rows.n_words // A)
+    blk = rows.words[:, : K * A].reshape(b, K, A)
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (b, K), 1)
+    parts = [
+        jnp.sum(
+            jnp.where((iota_k == bi0[:, None] + m)[:, :, None], blk,
+                      jnp.uint32(0)),
+            axis=1,
+        )
+        for m in range(_SUP_BLOCKS)
+    ]
+    return jnp.concatenate(parts, axis=1)  # uint32[B, _SUP_BLOCKS * A]
+
+
+def _sup_window(sup: jax.Array, p: jax.Array, bi0: jax.Array, n_words: int):
+    """:func:`_window`-contract byte window served FROM a superblock —
+    pure VPU work on [B, 512] bytes, no row pass.
+
+    ``p`` is the ROW byte position; the caller guarantees the window
+    fits the superblock (``(p >> 2) - bi0*_BLOCK_WORDS + n_words <=
+    _SUP_BLOCKS*_BLOCK_WORDS`` — the scan loops' ``can-process``
+    condition). Returns ``(win int32[B, n_words*4], a int32[B])`` with
+    window bytes identical to ``_window(rows, p, n_words)`` for every
+    such position.
+    """
+    b = p.shape[0]
+    A = _BLOCK_WORDS
+    wloc = (p >> 2) - bi0 * A  # superblock word position
+    sj = wloc // A
+    words = _two_level_words(
+        sup.reshape(b, _SUP_BLOCKS, A), sj, wloc - sj * A, n_words
+    )
+    return _words_to_bytes(words), (jnp.maximum(p, 0) & 3)
 
 
 def _wbyte(win: jax.Array, rel: jax.Array) -> jax.Array:
@@ -319,18 +393,11 @@ def _scan_issuer_cn(rows: _Rows, name_off, name_end, hdr_ok0):
     """
     b = name_off.shape[0]
     zero = jnp.zeros((b,), jnp.int32)
+    supw = _SUP_BLOCKS * _BLOCK_WORDS
+    stride = (supw - 8 - _BLOCK_WORDS) * 4
+    outer_max = -(-(rows.n_words * 4) // stride) + 1
 
-    def cond(carry):
-        r, p, _cn_off, _cn_len, alive = carry
-        return (r < MAX_RDNS) & jnp.any(alive & (p < name_end))
-
-    def body(carry):
-        r, p, cn_off, cn_len, alive = carry
-        active = alive & (p < name_end)
-        # One window covers the whole round: RDN SET header (≤5) + ATV
-        # SEQUENCE header (≤5) + OID header (2 for the 3-byte CN OID)
-        # + OID bytes (3) + value header (≤5) ⇒ ≤ 23 bytes + alignment.
-        win, a = _window(rows, p, 8)
+    def rdn_round(win, a, p, cn_off, cn_len, alive, cnt, active):
         d0 = jnp.zeros_like(p)
         tag, clen, hlen, hok = _read_header_w(win, a, d0, p, name_end)
         set_ok = active & hok & (tag == 0x31)
@@ -354,95 +421,194 @@ def _scan_issuer_cn(rows: _Rows, name_off, name_end, hdr_ok0):
         cn_off = jnp.where(take, p + dv + vhlen, cn_off)
         cn_len = jnp.where(take, vclen, cn_len)
         p = jnp.where(active & hok, p + hlen + clen, p)
+        cnt = cnt + (active & hok).astype(jnp.int32)
         alive = alive & jnp.where(active, hok, True)
-        return r + 1, p, cn_off, cn_len, alive
+        return p, cn_off, cn_len, alive, cnt
 
-    _, _, cn_off, cn_len, _ = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), name_off, zero, zero, hdr_ok0)
+    # Superblock loops (see _scan_extensions — same structure, same
+    # window bytes per round as the old one-row-pass-per-RDN loop):
+    # one row pass fetches each lane 512 bytes; RDNs are a few tens of
+    # bytes, so a typical issuer Name scans in ONE fetch.
+    def outer_cond(carry):
+        r_out, _p, _co, _cl, _alive, _cnt, live = carry
+        return (r_out < outer_max) & jnp.any(live)
+
+    def outer_body(carry):
+        r_out, p, cn_off, cn_len, alive, cnt, live = carry
+        bi0 = p >> (2 + 4)
+        sup = _sup_fetch(rows, bi0)
+
+        def inner_cond(c):
+            return jnp.any(c[-1])
+
+        def inner_body(c):
+            p, cn_off, cn_len, alive, cnt, go = c
+            win, a = _sup_window(sup, p, bi0, 8)
+            p, cn_off, cn_len, alive, cnt = rdn_round(
+                win, a, p, cn_off, cn_len, alive, cnt, go
+            )
+            wloc = (p >> 2) - bi0 * _BLOCK_WORDS
+            go = (alive & (p < name_end) & (cnt < MAX_RDNS)
+                  & (wloc <= supw - 8))
+            return p, cn_off, cn_len, alive, cnt, go
+
+        # `live` doubles as the first round's go: a lane freshly
+        # anchored at bi0 = p >> 6 always has wloc0 in [0, 16), so the
+        # fit guard is trivially true.
+        p, cn_off, cn_len, alive, cnt, _go = jax.lax.while_loop(
+            inner_cond, inner_body, (p, cn_off, cn_len, alive, cnt, live)
+        )
+        live = alive & (p < name_end) & (cnt < MAX_RDNS)
+        return r_out + 1, p, cn_off, cn_len, alive, cnt, live
+
+    live0 = hdr_ok0 & (name_off < name_end)
+    (_r, _p, cn_off, cn_len, _alive, _cnt, _live) = jax.lax.while_loop(
+        outer_cond, outer_body,
+        (jnp.int32(0), name_off, zero, zero, hdr_ok0, zero, live0),
     )
     return cn_off, cn_len
 
 
 def _scan_extensions(rows: _Rows, ext_off, ext_end, alive0):
     """Walk SEQUENCE OF Extension for BasicConstraints CA + CRLDP
-    presence. Early-exits once every lane has left its extension list
-    (typical certificates carry ~8–10 extensions)."""
+    presence.
+
+    Superblock structure (round-4 rework): at production batch widths
+    the early-exit never fires (some lane in a 2^20-lane batch always
+    has many extensions), so the OLD one-row-pass-per-extension loop
+    paid ~MAX_EXTS full HBM passes per batch. Now an OUTER loop
+    fetches each lane a 512-byte superblock anchored at its position
+    (ONE row pass, :func:`_sup_fetch`) and an INNER loop walks
+    extensions entirely inside the superblock (:func:`_sup_window` —
+    VPU-only); a lane waits for the next outer refetch only when its
+    11-word window would cross the superblock edge. Each outer round
+    therefore advances every active lane ≥ ~404 bytes (or to
+    completion), so the row-pass count drops from ~MAX_EXTS to
+    ≤ ceil(row/404) — the window bytes each round body sees are
+    IDENTICAL to the old per-round ``_window`` read, so per-lane
+    semantics (including the overrun and budget contracts) are
+    unchanged. The per-lane extension budget stays MAX_EXTS (the old
+    global round count bounded exactly the same thing).
+    """
     b = ext_off.shape[0]
     false = jnp.zeros((b,), bool)
     zero = jnp.zeros((b,), jnp.int32)
+    supw = _SUP_BLOCKS * _BLOCK_WORDS  # superblock words
+    # Bytes a lane is guaranteed to traverse per outer round before its
+    # window can cross the superblock edge (used for the outer budget).
+    stride = (supw - 11 - _BLOCK_WORDS) * 4
+    outer_max = -(-(rows.n_words * 4) // stride) + 1
 
-    def cond(carry):
-        r, p, _ca, _dp, _dpo, _dpl, alive = carry
-        return (r < MAX_EXTS) & jnp.any(alive & (p < ext_end))
+    def outer_cond(carry):
+        r_out, _p, _ca, _dp, _dpo, _dpl, _alive, _cnt, live = carry
+        return (r_out < outer_max) & jnp.any(live)
 
-    def body(carry):
-        r, p, is_ca, has_crldp, dp_off, dp_len, alive = carry
-        active = alive & (p < ext_end)
-        # One window per round: Extension header (≤5) + OID header (2)
-        # + OID (3) + critical BOOLEAN (≤3+1) + value header (≤5) + BC
-        # SEQUENCE header (≤3) + flag TLV (3) ⇒ ≤ 39 bytes + alignment.
-        win, a = _window(rows, p, 11)
-        d0 = jnp.zeros_like(p)
-        tag, clen, hlen, hok = _read_header_w(win, a, d0, p, ext_end)
-        ext_ok = active & hok & (tag == 0x30)
-        di = hlen
-        otag, oclen, ohlen, ook = _read_header_w(win, a, di, p, ext_end)
-        oid_ok = ext_ok & ook & (otag == 0x06) & (oclen == 3)
-        ro = a + di + ohlen
-        o0 = _wbyte(win, ro)
-        o1 = _wbyte(win, ro + 1)
-        o2 = _wbyte(win, ro + 2)
-        is_bc = oid_ok & (o0 == 0x55) & (o1 == 0x1D) & (o2 == 0x13)
-        is_dp = oid_ok & (o0 == 0x55) & (o1 == 0x1D) & (o2 == 0x1F)
-        # optional BOOLEAN critical
-        dc = di + ohlen + oclen
-        ctag, cclen, chlen, cok = _read_header_w(win, a, dc, p, ext_end)
-        has_crit = cok & (ctag == 0x01)
-        dv = jnp.where(has_crit, dc + chlen + cclen, dc)
-        vtag, vclen, vhlen, vok = _read_header_w(win, a, dv, p, ext_end)
-        # extnValue must fit INSIDE its Extension frame (hlen + clen),
-        # not merely inside the extension list — an inflated value
-        # length would otherwise window into the next extension's
-        # bytes. The whole LANE is rejected (host-lane fallback), in
-        # lockstep with the host parser's DerError on the same input
-        # (pinned by the walker/host mutation fuzz). The overrun check
-        # uses a limit-free header re-read: a value whose end ALSO
-        # crosses ext_end makes vok itself False, which must still
-        # count as an overrun, not a silent skip (the list bound is a
-        # superset of the frame bound). Same window bytes — pure
-        # arithmetic, no extra gather.
-        _vt2, vclen2, vhlen2, vok2 = _read_header_w(
-            win, a, dv, p, jnp.int32(2**30)
+    def outer_body(carry):
+        r_out, p, is_ca, has_crldp, dp_off, dp_len, alive, cnt, live = carry
+        bi0 = p >> (2 + 4)  # anchor block: p // (4 bytes * 16 words)
+        sup = _sup_fetch(rows, bi0)
+
+        def inner_cond(c):
+            (_p, _ca, _dp, _dpo, _dpl, _alive, _cnt, go) = c
+            return jnp.any(go)
+
+        def inner_body(c):
+            p, is_ca, has_crldp, dp_off, dp_len, alive, cnt, go = c
+            win, a = _sup_window(sup, p, bi0, 11)
+            (p, is_ca, has_crldp, dp_off, dp_len, alive, cnt) = _ext_round(
+                win, a, p, ext_end,
+                is_ca, has_crldp, dp_off, dp_len, alive, cnt, go,
+            )
+            wloc = (p >> 2) - bi0 * _BLOCK_WORDS
+            go = (alive & (p < ext_end) & (cnt < MAX_EXTS)
+                  & (wloc <= supw - 11))
+            return p, is_ca, has_crldp, dp_off, dp_len, alive, cnt, go
+
+        # `live` doubles as the first round's go: a lane freshly
+        # anchored at bi0 = p >> 6 always has wloc0 in [0, 16), so the
+        # fit guard is trivially true.
+        (p, is_ca, has_crldp, dp_off, dp_len, alive, cnt, _go) = (
+            jax.lax.while_loop(
+                inner_cond, inner_body,
+                (p, is_ca, has_crldp, dp_off, dp_len, alive, cnt, live),
+            )
         )
-        overrun = ext_ok & vok2 & (dv + vhlen2 + vclen2 > hlen + clen)
-        val_ok = vok & (vtag == 0x04) & ~overrun
-        # BasicConstraints ::= SEQUENCE { cA BOOLEAN DEFAULT FALSE, ... }
-        db = dv + vhlen
-        btag, bclen, bhlen, bok = _read_header_w(win, a, db, p, ext_end)
-        bc_seq_ok = val_ok & bok & (btag == 0x30)
-        df = db + bhlen
-        ftag, fclen, fhlen, fok = _read_header_w(win, a, df, p, ext_end)
-        ca_flag = (
-            bc_seq_ok & (bclen > 0) & fok & (ftag == 0x01) & (fclen == 1)
-            & (_wbyte(win, a + df + fhlen) != 0)
-        )
-        is_ca = is_ca | (is_bc & ca_flag)
-        take_dp = is_dp & val_ok & (dp_len == 0)
-        dp_off = jnp.where(take_dp, p + dv + vhlen, dp_off)
-        dp_len = jnp.where(take_dp, vclen, dp_len)
-        has_crldp = has_crldp | (is_dp & val_ok)
-        p = jnp.where(active & hok, p + hlen + clen, p)
-        alive = alive & jnp.where(active, hok & ~overrun, True)
-        return r + 1, p, is_ca, has_crldp, dp_off, dp_len, alive
+        live = alive & (p < ext_end) & (cnt < MAX_EXTS)
+        return (r_out + 1, p, is_ca, has_crldp, dp_off, dp_len, alive,
+                cnt, live)
 
-    _, p, is_ca, has_crldp, dp_off, dp_len, alive = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), ext_off, false, false, zero, zero, alive0)
+    live0 = alive0 & (ext_off < ext_end)
+    (_r, p, is_ca, has_crldp, dp_off, dp_len, alive, _cnt, _live) = (
+        jax.lax.while_loop(
+            outer_cond, outer_body,
+            (jnp.int32(0), ext_off, false, false, zero, zero, alive0,
+             zero, live0),
+        )
     )
-    # Lanes still inside the window after MAX_EXTS rounds exhausted the
-    # loop budget — flag them (host lane) rather than silently missing
-    # a trailing basicConstraints.
+    # Lanes still inside the window after exhausting the extension
+    # budget — flag them (host lane) rather than silently missing a
+    # trailing basicConstraints.
     exhausted = alive & (p < ext_end)
     return is_ca, has_crldp, dp_off, dp_len, alive & ~exhausted
+
+
+def _ext_round(win, a, p, ext_end, is_ca, has_crldp, dp_off, dp_len,
+               alive, cnt, active):
+    """One extension parse against a window anchored at ``p`` — the
+    original per-round body, window source abstracted out."""
+    d0 = jnp.zeros_like(p)
+    tag, clen, hlen, hok = _read_header_w(win, a, d0, p, ext_end)
+    ext_ok = active & hok & (tag == 0x30)
+    di = hlen
+    otag, oclen, ohlen, ook = _read_header_w(win, a, di, p, ext_end)
+    oid_ok = ext_ok & ook & (otag == 0x06) & (oclen == 3)
+    ro = a + di + ohlen
+    o0 = _wbyte(win, ro)
+    o1 = _wbyte(win, ro + 1)
+    o2 = _wbyte(win, ro + 2)
+    is_bc = oid_ok & (o0 == 0x55) & (o1 == 0x1D) & (o2 == 0x13)
+    is_dp = oid_ok & (o0 == 0x55) & (o1 == 0x1D) & (o2 == 0x1F)
+    # optional BOOLEAN critical
+    dc = di + ohlen + oclen
+    ctag, cclen, chlen, cok = _read_header_w(win, a, dc, p, ext_end)
+    has_crit = cok & (ctag == 0x01)
+    dv = jnp.where(has_crit, dc + chlen + cclen, dc)
+    vtag, vclen, vhlen, vok = _read_header_w(win, a, dv, p, ext_end)
+    # extnValue must fit INSIDE its Extension frame (hlen + clen),
+    # not merely inside the extension list — an inflated value
+    # length would otherwise window into the next extension's
+    # bytes. The whole LANE is rejected (host-lane fallback), in
+    # lockstep with the host parser's DerError on the same input
+    # (pinned by the walker/host mutation fuzz). The overrun check
+    # uses a limit-free header re-read: a value whose end ALSO
+    # crosses ext_end makes vok itself False, which must still
+    # count as an overrun, not a silent skip (the list bound is a
+    # superset of the frame bound). Same window bytes — pure
+    # arithmetic, no extra gather.
+    _vt2, vclen2, vhlen2, vok2 = _read_header_w(
+        win, a, dv, p, jnp.int32(2**30)
+    )
+    overrun = ext_ok & vok2 & (dv + vhlen2 + vclen2 > hlen + clen)
+    val_ok = vok & (vtag == 0x04) & ~overrun
+    # BasicConstraints ::= SEQUENCE { cA BOOLEAN DEFAULT FALSE, ... }
+    db = dv + vhlen
+    btag, bclen, bhlen, bok = _read_header_w(win, a, db, p, ext_end)
+    bc_seq_ok = val_ok & bok & (btag == 0x30)
+    df = db + bhlen
+    ftag, fclen, fhlen, fok = _read_header_w(win, a, df, p, ext_end)
+    ca_flag = (
+        bc_seq_ok & (bclen > 0) & fok & (ftag == 0x01) & (fclen == 1)
+        & (_wbyte(win, a + df + fhlen) != 0)
+    )
+    is_ca = is_ca | (is_bc & ca_flag)
+    take_dp = is_dp & val_ok & (dp_len == 0)
+    dp_off = jnp.where(take_dp, p + dv + vhlen, dp_off)
+    dp_len = jnp.where(take_dp, vclen, dp_len)
+    has_crldp = has_crldp | (is_dp & val_ok)
+    p = jnp.where(active & hok, p + hlen + clen, p)
+    cnt = cnt + (active & hok).astype(jnp.int32)
+    alive = alive & jnp.where(active, hok & ~overrun, True)
+    return p, is_ca, has_crldp, dp_off, dp_len, alive, cnt
 
 
 @functools.partial(jax.jit, static_argnames=("scan_issuer_cn",))
