@@ -35,6 +35,9 @@ def make_cert(
     is_ca: bool = True,
     add_basic_constraints: bool = True,
     key_seed: int = 0,
+    extra_extensions: int = 0,
+    extra_ext_size: int = 40,
+    extras_first: bool = True,
 ) -> bytes:
     """Build a self-signed certificate, returning DER bytes."""
     now = datetime.datetime(2024, 1, 1, tzinfo=datetime.timezone.utc)
@@ -69,10 +72,28 @@ def make_cert(
         .not_valid_before(not_before)
         .not_valid_after(not_after)
     )
+    def _add_extras(b):
+        # Unrecognized private-arc extensions (OCTET STRING payloads):
+        # pad the extension list to stress budget/refetch paths in the
+        # device walker's extension scan.
+        for i in range(extra_extensions):
+            b = b.add_extension(
+                x509.UnrecognizedExtension(
+                    x509.ObjectIdentifier(f"1.3.6.1.4.1.99999.{i}"),
+                    bytes([i & 0xFF]) * extra_ext_size,
+                ),
+                critical=False,
+            )
+        return b
+
+    if extras_first:
+        builder = _add_extras(builder)
     if add_basic_constraints:
         builder = builder.add_extension(
             x509.BasicConstraints(ca=is_ca, path_length=None), critical=True
         )
+    if not extras_first:
+        builder = _add_extras(builder)
     if crl_dps:
         builder = builder.add_extension(
             x509.CRLDistributionPoints(

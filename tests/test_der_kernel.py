@@ -89,6 +89,56 @@ def test_cn_scan_gated_off():
         ), field
 
 
+def test_extension_scan_superblock_stress():
+    """The superblock extension scan must survive certificates whose
+    extension lists span multiple 512-byte superblocks, skip huge
+    opaque extensions via header arithmetic, and flag (not misparse)
+    lanes that exceed the per-lane extension budget."""
+    ders = [
+        # 12 extensions of ~50 B each + BC LAST: ~600 B of extensions,
+        # at least two superblock fetches, BC still found exactly.
+        make_cert(serial=10, is_ca=True, extra_extensions=12,
+                  extra_ext_size=40, extras_first=True),
+        # One SCT-sized (600 B) opaque extension BEFORE BC(CA=true):
+        # the frame is consumed by header arithmetic far past the
+        # parse window — a mis-skip that misses BC would read CA=false.
+        make_cert(serial=11, is_ca=True, extra_extensions=1,
+                  extra_ext_size=600, extras_first=True),
+        # Budget exhaustion: 30 extensions exceed MAX_EXTS — the lane
+        # must come back not-ok (host lane), never silently wrong.
+        make_cert(serial=12, is_ca=True, extra_extensions=30,
+                  extra_ext_size=8, extras_first=True),
+        # CRLDP after a long run of unknown extensions.
+        make_cert(serial=13, is_ca=False, extras_first=True,
+                  extra_extensions=10, extra_ext_size=60,
+                  crl_dps=("http://crl.example.com/x.crl",)),
+        # BC FIRST, then a long unknown tail: the scan must keep the
+        # early CA verdict while walking (and budget-bounding) the rest.
+        make_cert(serial=14, is_ca=True, extras_first=False,
+                  extra_extensions=12, extra_ext_size=40),
+    ]
+    assert der_kernel.MAX_EXTS < 30 + 1  # fixture really exceeds budget
+    data, length = pack(ders)
+    out = der_kernel.parse_certs(data, length)
+    # Lane 0: exact CA flag despite BC sitting ~600 B into the list.
+    assert bool(out.ok[0]) and bool(out.is_ca[0])
+    # Lane 1: huge opaque extension skipped, BC(CA=true) parsed after
+    # it — a silent mis-skip would miss BC and report CA=false.
+    assert bool(out.ok[1]) and bool(out.is_ca[1])
+    # Lane 2: budget exceeded -> host lane, and the host parser (the
+    # reference behavior) still classifies it fine.
+    assert not bool(out.ok[2])
+    assert hostder.parse_cert(ders[2]).is_ca
+    # Lane 3: CRLDP found beyond the first superblock — decode the
+    # device-reported extnValue window and require URL equality.
+    assert bool(out.ok[3]) and bool(out.has_crldp[3])
+    ref = hostder.parse_cert(ders[3])
+    dev_urls = hostder._parse_crldp(ders[3], int(out.crldp_off[3]))
+    assert sorted(dev_urls) == sorted(ref.crl_distribution_points)
+    # Lane 4: BC before the unknown tail keeps its CA verdict.
+    assert bool(out.ok[4]) and bool(out.is_ca[4])
+
+
 def test_serial_gather():
     ders = fixture_certs()
     data, length = pack(ders)
