@@ -41,10 +41,14 @@ at its per-lane position via one-hot × shifted-slice multiply-reduce
 production row widths the one-hot is TWO-LEVEL — select two adjacent
 _BLOCK_WORDS-word blocks in one pass over the row, then window within
 the superblock — cutting per-window reduce work ~nw/_BLOCK_WORDS×), and
-all byte reads inside a step are one-hot selects over that ≤48-byte
-window. The scan loops are ``while_loop``s that exit as soon as every
-lane is done, so typical certificates pay ~4–10 rounds, not the
-worst-case budget.
+all byte reads inside a step are one-hot selects over that ≤68-byte
+window (17 words — exactly the _PAD_WORDS+1 and _BLOCK_WORDS+1
+ceiling). The fixed walk merges adjacent headers into 5 shared
+windows; the variable-count scans (issuer RDNs, extensions) run as
+superblock loops — fetch each lane 512 bytes in one row pass, walk
+TLV elements inside it at VPU speed, refetch on crossing — so a
+batch pays ~one row pass per ~468 bytes of scanned region instead of
+one per TLV element.
 """
 
 from __future__ import annotations
@@ -59,8 +63,12 @@ import numpy as np
 MAX_RDNS = 12  # RDN components scanned in the issuer Name
 MAX_EXTS = 24  # extensions scanned in the TBS
 
-_PAD_WORDS = 13  # slack words so shifted slices cover every window
-# (every _window call asserts n_words <= _PAD_WORDS + 1)
+_PAD_WORDS = 16  # slack words so shifted slices cover every window
+# (every _window call asserts n_words <= _PAD_WORDS + 1; the binding
+# consumer is window 1's 17 words = 68 bytes, which must reach the
+# sigAlg HEADER past a maximum-width serial: 5+5+5+2+46+5 = 68
+# exactly. 17 words also sits exactly at the _BLOCK_WORDS + 1
+# two-level ceiling — there is NO slack left at this size.)
 
 _BLOCK_WORDS = 16  # two-level window: block granularity (see _window)
 
@@ -643,53 +651,68 @@ def parse_certs_rows(
     limit = length
 
     ok = length > 4
-    p = jnp.zeros((b,), jnp.int32)
+    zero = jnp.zeros((b,), jnp.int32)
+    d0 = zero
 
-    # Certificate ::= SEQUENCE { tbsCertificate, sigAlg, sig }
-    tag, clen, hlen, hok = _header_at(rows, p, limit)
+    # The fixed walk pays ~one HBM row pass per window, so adjacent
+    # headers are MERGED into shared windows wherever the next header
+    # sits within reach for every well-formed certificate (11 windows
+    # → 5). Reads that an adversarial length field pushes past a
+    # merged window see zeros — each merge carries an explicit
+    # in-window guard that routes such lanes to the exact host lane
+    # instead of decoding the zeros (real certificates sit well inside
+    # every guard; the guards exist so a crafted length can only cost
+    # a host parse, never mis-extract).
+
+    # -- window 1 (17 words = 68 bytes, anchored at 0): Certificate
+    # SEQUENCE + TBSCertificate SEQUENCE + [0] version OPTIONAL +
+    # serial INTEGER + signature AlgorithmIdentifier HEADER. Only the
+    # alg header is read here (its frame is then skipped
+    # arithmetically), so any AlgorithmIdentifier size — including
+    # RSASSA-PSS's ~67-byte frame — stays on the device path. 68
+    # bytes reach the alg header even for the 46-byte serial ceiling
+    # (the widest serial the device schema accepts at all).
+    w1 = 17 * 4  # window bytes — guards below must use this bound
+    win, a = _window(rows, zero, w1 // 4)
+    tag, clen, hlen, hok = _read_header_w(win, a, d0, zero, limit)
     ok &= hok & (tag == 0x30)
-    p = p + hlen
-
-    # TBSCertificate ::= SEQUENCE { ... }
-    tag, clen, hlen, hok = _header_at(rows, p, limit)
+    d_tbs = hlen  # header lengths are ≤ 6, so every delta through the
+    tag, clen, hlen, hok = _read_header_w(win, a, d_tbs, zero, limit)
     ok &= hok & (tag == 0x30)
-    tbs_end = p + hlen + clen
-    p = p + hlen
-
-    # [0] EXPLICIT Version OPTIONAL + serialNumber INTEGER share one
-    # window (version TLV is ≤ 7 bytes; serial header within reach).
-    win, a = _window(rows, p, 6)
-    d0 = jnp.zeros_like(p)
-    tag, clen, hlen, hok = _read_header_w(win, a, d0, p, tbs_end)
+    tbs_end = d_tbs + hlen + clen
+    d = d_tbs + hlen  # ... version header stays in-window by bound
+    tag, clen, hlen, hok = _read_header_w(win, a, d, zero, tbs_end)
     has_version = hok & (tag == 0xA0)
-    dser = jnp.where(has_version, hlen + clen, 0)
-    tag, clen, hlen, hok = _read_header_w(win, a, dser, p, tbs_end)
-    ok &= hok & (tag == 0x02)
-    serial_off = p + dser + hlen
+    dser = d + jnp.where(has_version, hlen + clen, 0)
+    tag, clen, hlen, hok = _read_header_w(win, a, dser, zero, tbs_end)
+    # Guard: the serial header's 5 bytes must all be in-window (an
+    # adversarial version frame pushes dser out of reach).
+    ok &= hok & (tag == 0x02) & (a + dser + 5 <= w1)
+    serial_off = dser + hlen
     serial_len = clen
-    p = p + dser + hlen + clen
+    d_alg = dser + hlen + clen
+    tag, clen, hlen, hok = _read_header_w(win, a, d_alg, zero, tbs_end)
+    ok &= hok & (tag == 0x30) & (a + d_alg + 5 <= w1)
+    p = d_alg + hlen + clen  # past the whole AlgorithmIdentifier
 
-    # signature AlgorithmIdentifier
-    tag, clen, hlen, hok = _header_at(rows, p, tbs_end)
-    ok &= hok & (tag == 0x30)
-    p = p + hlen + clen
-
-    # issuer Name — scanned for the first CN
+    # -- issuer Name header: own small window anchored right at it.
     tag, clen, hlen, hok = _header_at(rows, p, tbs_end)
     ok &= hok & (tag == 0x30)
     issuer_off = p
     issuer_len_out = hlen + clen
-    issuer_inner = p + hlen
-    issuer_end = p + hlen + clen
+    issuer_inner = issuer_off + hlen
+    issuer_end = issuer_off + hlen + clen
     if scan_issuer_cn:
         cn_off, cn_len = _scan_issuer_cn(rows, issuer_inner, issuer_end, ok)
     else:  # CN filter disabled (static) — skip the RDN scan entirely
         cn_off = cn_len = jnp.zeros((b,), jnp.int32)
     p = issuer_end
 
-    # validity SEQUENCE { notBefore, notAfter } — one window covers the
-    # validity header, notBefore TLV (≤ 20 bytes) and notAfter TLV.
-    win, a = _window(rows, p, 13)
+    # -- window 3 (13 words): validity SEQUENCE { notBefore, notAfter }
+    # + subject Name header (validity is ≤ ~36 bytes; the time parser's
+    # strict digit checks reject any out-of-window zero reads).
+    w3 = 13 * 4
+    win, a = _window(rows, p, w3 // 4)
     tag, clen, hlen, hok = _read_header_w(win, a, d0, p, tbs_end)
     ok &= hok & (tag == 0x30)
     dnb = hlen
@@ -699,33 +722,38 @@ def parse_certs_rows(
         win, a, dnb + nb_hlen + nb_clen, p
     )
     ok &= t_ok
-    p = p + hlen + clen
+    d_subj = hlen + clen
+    tag, clen, hlen, hok = _read_header_w(win, a, d_subj, p, tbs_end)
+    ok &= hok & (tag == 0x30) & (a + d_subj + 5 <= w3)
+    p = p + d_subj + hlen + clen  # past the subject Name
 
-    # subject Name
-    tag, clen, hlen, hok = _header_at(rows, p, tbs_end)
-    ok &= hok & (tag == 0x30)
-    p = p + hlen + clen
-
-    # subjectPublicKeyInfo
+    # -- subjectPublicKeyInfo header: own window (the subject Name
+    # length is unbounded, so no merge is possible).
     tag, clen, hlen, hok = _header_at(rows, p, tbs_end)
     ok &= hok & (tag == 0x30)
     spki_off = p
     spki_len = hlen + clen
     p = p + hlen + clen
 
-    # optional [1] issuerUniqueID / [2] subjectUniqueID (primitive or
-    # constructed context tags 1/2)
+    # -- window 4 (13 words): optional [1]/[2] UniqueID frames + [3]
+    # EXPLICIT Extensions header + inner SEQUENCE header.
+    w4 = 13 * 4
+    win, a = _window(rows, p, w4 // 4)
+    d = zero
     for _ in range(2):
-        tag, clen, hlen, hok = _header_at(rows, p, tbs_end)
+        tag, clen, hlen, hok = _read_header_w(win, a, d, p, tbs_end)
         is_uid = hok & ((tag == 0x81) | (tag == 0x82) | (tag == 0xA1) | (tag == 0xA2))
-        p = jnp.where(is_uid, p + hlen + clen, p)
-
-    # [3] EXPLICIT Extensions OPTIONAL — its header and the inner
-    # SEQUENCE header share one window (both ≤ 5 bytes).
-    win, a = _window(rows, p, 4)
-    tag, clen, hlen, hok = _read_header_w(win, a, d0, p, tbs_end)
-    has_ext = hok & (tag == 0xA3) & (p < tbs_end)
-    de = hlen
+        d = jnp.where(is_uid, d + hlen + clen, d)
+    # Both the [3] header and the inner SEQUENCE header (≤ 6 + 5
+    # bytes) must decode in-window. UniqueID frames large enough to
+    # push them out (absent from real CT certificates) go host-side —
+    # reading zeros there would silently classify the lane as
+    # "no extensions".
+    in_win = a + d + 11 <= w4
+    ok &= in_win | ((p + d) >= tbs_end)
+    tag, clen, hlen, hok = _read_header_w(win, a, d, p, tbs_end)
+    has_ext = hok & (tag == 0xA3) & ((p + d) < tbs_end) & in_win
+    de = d + hlen
     etag, eclen, ehlen, eok = _read_header_w(win, a, de, p, tbs_end)
     ext_listed = has_ext & eok & (etag == 0x30)
     ok &= jnp.where(has_ext, eok & (etag == 0x30), True)

@@ -139,6 +139,49 @@ def test_extension_scan_superblock_stress():
     assert bool(out.ok[4]) and bool(out.is_ca[4])
 
 
+def test_rsassa_pss_on_device_path():
+    """An RSASSA-PSS-signed certificate (~67-byte signature
+    AlgorithmIdentifier frame) must stay ON the device path: the fixed
+    walk reads only the alg HEADER (window 1) and skips the frame
+    arithmetically, so alg size never forces a host fallback."""
+    import datetime as _dt
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "PSS CA")])
+    now = _dt.datetime(2024, 1, 1, tzinfo=_dt.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(0x00BEEF11)
+        .not_valid_before(now)
+        .not_valid_after(now + _dt.timedelta(days=900))
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                       critical=True)
+        .sign(key, hashes.SHA256(),
+              rsa_padding=padding.PSS(
+                  mgf=padding.MGF1(hashes.SHA256()),
+                  salt_length=32))
+    )
+    from cryptography.hazmat.primitives import serialization
+
+    der = cert.public_bytes(serialization.Encoding.DER)
+    data, length = pack([der])
+    out = der_kernel.parse_certs(data, length)
+    assert bool(out.ok[0]), "PSS cert fell off the device path"
+    ref = hostder.parse_cert(der)
+    assert int(out.serial_off[0]) == ref.serial_off
+    assert int(out.serial_len[0]) == ref.serial_len
+    assert int(out.not_after_hour[0]) == ref.not_after_unix_hour
+    assert bool(out.is_ca[0]) == ref.is_ca
+    assert int(out.spki_off[0]) == ref.spki_off
+
+
 def test_serial_gather():
     ders = fixture_certs()
     data, length = pack(ders)
