@@ -174,7 +174,14 @@ class MetricsDumper:
                 f"  [S] {k}: count={s['count']} mean={s['mean']:.6f}s "
                 f"min={s['min']:.6f}s max={s['max']:.6f}s"
             )
-        print("\n".join(lines), file=self.out, flush=True)
+        try:
+            print("\n".join(lines), file=self.out, flush=True)
+        except (ValueError, OSError):
+            # The sink stream can already be closed when a dump races
+            # interpreter (or pytest capture) teardown — losing one
+            # periodic stats dump there is fine; crashing the dumper
+            # thread with an unraisable exception is not.
+            pass
 
     def stop(self) -> None:
         self._stop.set()
