@@ -182,6 +182,61 @@ def test_rsassa_pss_on_device_path():
     assert int(out.spki_off[0]) == ref.spki_off
 
 
+def _splice_serial(der: bytes, new_serial: bytes) -> bytes:
+    """Replace the TBS serialNumber content with ``new_serial`` via raw
+    DER surgery (signature becomes invalid — irrelevant, neither parser
+    verifies it). cryptography caps builder serials at 20 octets, but
+    real logs carry non-conforming certs; the device schema accepts up
+    to MAX_SERIAL_BYTES = 46."""
+    f = hostder.parse_cert(der)
+    assert f.serial_len < 128
+    tlv_start = f.serial_off - 2  # short-form INTEGER header
+    assert der[tlv_start] == 0x02 and der[tlv_start + 1] == f.serial_len
+    assert len(new_serial) < 128
+    new_tlv = bytes([0x02, len(new_serial)]) + new_serial
+    delta = len(new_tlv) - (2 + f.serial_len)
+    # Fix the two enclosing long-form lengths (cert SEQ, TBS SEQ);
+    # sizes stay in the 0x82 two-byte range for these fixtures.
+    assert der[0] == 0x30 and der[1] == 0x82
+    assert der[4] == 0x30 and der[5] == 0x82
+    cert_len = int.from_bytes(der[2:4], "big") + delta
+    tbs_len = int.from_bytes(der[6:8], "big") + delta
+    return (bytes([0x30, 0x82]) + cert_len.to_bytes(2, "big")
+            + bytes([0x30, 0x82]) + tbs_len.to_bytes(2, "big")
+            + der[8:tlv_start] + new_tlv
+            + der[tlv_start + 2 + f.serial_len:])
+
+
+def test_serial_ceiling_46_bytes():
+    """Non-conforming wide serials: 46 bytes (the device schema
+    ceiling, and exactly window 1's 68-byte reach) must parse on
+    device with exact raw bytes; 47 bytes must overflow the gather
+    window (host lane), never truncate."""
+    from ct_mapreduce_tpu.core import packing
+
+    base = make_cert(serial=0xAB, subject_cn="wide.example.com", is_ca=False)
+    wide46 = bytes([0x00, 0x7F]) + bytes(range(2, 46))  # leading zero kept
+    der46 = _splice_serial(base, wide46)
+    assert hostder.parse_cert(der46).serial_len == 46  # surgery sane
+    der47 = _splice_serial(base, bytes(47))
+    data, length = pack([der46, der47])
+    out = der_kernel.parse_certs(data, length)
+    assert bool(out.ok[0]) and int(out.serial_len[0]) == 46
+    got = der46[int(out.serial_off[0]): int(out.serial_off[0]) + 46]
+    assert got == wide46  # raw bytes incl. leading zero
+    serials, fits = der_kernel.gather_serials(
+        data, out.serial_off, out.serial_len, packing.MAX_SERIAL_BYTES
+    )
+    import numpy as _np
+
+    assert bool(fits[0])
+    assert bytes(_np.asarray(serials[0][:46], dtype=_np.uint8)) == wide46
+    # 47-byte serial: the walker parses the TLV (ok, correct length),
+    # but it cannot ride the packed schema -> fits=False (host lane).
+    assert bool(out.ok[1]) and int(out.serial_len[1]) == 47
+    assert not bool(fits[1])
+
+
 def test_serial_gather():
     ders = fixture_certs()
     data, length = pack(ders)
