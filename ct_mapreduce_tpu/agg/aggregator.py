@@ -53,7 +53,7 @@ import numpy as np
 from ct_mapreduce_tpu.core import der as hostder
 from ct_mapreduce_tpu.core import packing
 from ct_mapreduce_tpu.core.types import ExpDate, Issuer
-from ct_mapreduce_tpu.ops import hashtable, pipeline
+from ct_mapreduce_tpu.ops import der_kernel, hashtable, pipeline
 from ct_mapreduce_tpu.telemetry.metrics import incr_counter, set_gauge
 
 
@@ -410,13 +410,20 @@ class TpuAggregator:
     # -- config ----------------------------------------------------------
     def set_cn_prefixes(self, prefixes: tuple[str, ...]) -> None:
         self.cn_prefixes = tuple(prefixes)
-        k = 32
+        encoded = [p.encode("utf-8") for p in prefixes]
+        # Device window sized to the longest prefix, capped at what a
+        # single fixed window can serve. Prefixes longer than the cap
+        # are compared on their head; head-matching lanes route to the
+        # exact host lane (pipeline._cn_prefix_match "undecidable"),
+        # so the device never silently decides on a truncated prefix.
+        cap = der_kernel.MAX_FIXED_WINDOW_BYTES
+        k = max(1, min(cap, max((len(b) for b in encoded), default=1)))
         arr = np.zeros((len(prefixes), k), np.uint8)
-        lens = np.zeros((len(prefixes),), np.int32)
-        for i, pfx in enumerate(prefixes):
-            b = pfx.encode("utf-8")[:k]
-            arr[i, : len(b)] = np.frombuffer(b, np.uint8)
-            lens[i] = len(b)
+        lens = np.zeros((len(prefixes), 2), np.int32)
+        for i, b in enumerate(encoded):
+            head = b[:k]
+            arr[i, : len(head)] = np.frombuffer(head, np.uint8)
+            lens[i] = (len(head), len(b))
         self._prefix_arr, self._prefix_lens = arr, lens
 
     def _now_hour(self) -> int:

@@ -378,7 +378,7 @@ class ShardedDedup:
     ) -> ShardedStepOut:
         if cn_prefixes is None:
             cn_prefixes = np.zeros((0, 32), np.uint8)
-            cn_prefix_lens = np.zeros((0,), np.int32)
+            cn_prefix_lens = np.zeros((0, 2), np.int32)
         b, l = data.shape
         fn = self._compiled(b, l, cn_prefixes.shape[0], cn_prefixes.shape[1])
         batch_sharding = NamedSharding(self.mesh, P(self.axis))

@@ -75,6 +75,10 @@ _BLOCK_WORDS = 16  # two-level window: block granularity (see _window)
 _SUP_BLOCKS = 8  # superblock loops: blocks fetched per scan round
 # (512 bytes — covers a typical whole extension list in ONE row pass)
 
+# Largest content span `window_bytes_rows` can serve: its window needs
+# (6 + n)//4 + 1 words, bounded by min(_PAD_WORDS, _BLOCK_WORDS) + 1.
+MAX_FIXED_WINDOW_BYTES = min(_PAD_WORDS, _BLOCK_WORDS) * 4 + 3 - 6  # 61
+
 
 class ParsedCerts(NamedTuple):
     """Per-lane extraction results (int32 unless noted)."""

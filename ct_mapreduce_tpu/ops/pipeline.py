@@ -91,23 +91,41 @@ def fingerprints(
 def _cn_prefix_match(
     rows, cn_off: jax.Array, cn_len: jax.Array,
     prefixes: jax.Array, prefix_lens: jax.Array,
-) -> jax.Array:
-    """Does the issuer CN start with any configured prefix? bool[B].
+) -> tuple[jax.Array, jax.Array]:
+    """Does the issuer CN start with any configured prefix?
 
-    prefixes: uint8[P, K]; prefix_lens: int32[P]. P == 0 handled by the
-    caller (filter disabled). ``rows`` are the shared word-packed rows
+    prefixes: uint8[P, K] (first K bytes of each prefix, K ≤
+    der_kernel.MAX_FIXED_WINDOW_BYTES); prefix_lens: int32[P, 2] —
+    column 0 the device-comparable length (= min(len, K)), column 1
+    the TRUE configured length. P == 0 handled by the caller (filter
+    disabled). ``rows`` are the shared word-packed rows
     (:func:`der_kernel.window_bytes_rows` — gather-free).
+
+    Returns ``(hit, undecidable)`` bool[B]: ``hit`` = definitely
+    matches some prefix; ``undecidable`` = matches the K-byte head of
+    a LONGER-than-K prefix and is long enough that the tail could
+    match — the device cannot decide, so the lane must take the exact
+    host lane (the reference compares full prefixes,
+    /root/reference/cmd/ct-fetch/ct-fetch.go:56-62).
     """
     k = prefixes.shape[1]
     window = der_kernel.window_bytes_rows(rows, cn_off, k).astype(jnp.uint8)
     inside = jnp.arange(k, dtype=jnp.int32)[None, :] < cn_len[:, None]
     window = jnp.where(inside, window, 0)
-    # [B, P, K] compare, masked beyond each prefix's length
+    dev_lens = prefix_lens[:, 0]
+    true_lens = prefix_lens[:, 1]
+    # [B, P, K] compare, masked beyond each prefix's device length
     eq = window[:, None, :] == prefixes[None, :, :]
-    care = jnp.arange(k, dtype=jnp.int32)[None, None, :] < prefix_lens[None, :, None]
+    care = jnp.arange(k, dtype=jnp.int32)[None, None, :] < dev_lens[None, :, None]
     full = jnp.all(eq | ~care, axis=-1)  # [B, P]
-    long_enough = cn_len[:, None] >= prefix_lens[None, :]
-    return jnp.any(full & long_enough, axis=-1)
+    truncated = (true_lens > dev_lens)[None, :]
+    hit = jnp.any(
+        full & (cn_len[:, None] >= dev_lens[None, :]) & ~truncated, axis=-1
+    )
+    undecidable = jnp.any(
+        full & (cn_len[:, None] >= true_lens[None, :]) & truncated, axis=-1
+    )
+    return hit, undecidable
 
 
 class LocalLanes(NamedTuple):
@@ -159,13 +177,17 @@ def local_lanes(
     f_ca = ok & parsed.is_ca
     f_expired = ok & ~f_ca & (parsed.not_after_hour < now_hour)
     if cn_prefixes.shape[0] > 0:
-        cn_hit = _cn_prefix_match(
+        cn_hit, cn_undec = _cn_prefix_match(
             rows, parsed.issuer_cn_off, parsed.issuer_cn_len,
             cn_prefixes, cn_prefix_lens,
         )
-        f_cn = ok & ~f_ca & ~f_expired & ~cn_hit
+        # A lane matching only the truncated head of an over-long
+        # prefix is NOT filtered here — it routes to the exact host
+        # lane below (device_exact), where full prefixes decide.
+        cn_undec = ok & ~f_ca & ~f_expired & ~cn_hit & cn_undec
+        f_cn = ok & ~f_ca & ~f_expired & ~cn_hit & ~cn_undec
     else:
-        f_cn = jnp.zeros_like(ok)
+        f_cn = cn_undec = jnp.zeros_like(ok)
     passed = ok & ~f_ca & ~f_expired & ~f_cn
 
     # Device-exactness gate: lanes outside the packed schema go host-side.
@@ -181,7 +203,7 @@ def local_lanes(
     meta_ok = (hour_off >= 0) & (hour_off < packing.META_HOUR_SPAN)
     idx_ok = (issuer_idx >= 0) & (issuer_idx < num_issuers)
     boundary_hour = parsed.not_after_hour == now_hour
-    device_exact = fits & meta_ok & idx_ok & ~boundary_hour
+    device_exact = fits & meta_ok & idx_ok & ~boundary_hour & ~cn_undec
 
     fps = fingerprints(issuer_idx, parsed.not_after_hour, serials, parsed.serial_len)
     meta = (
@@ -223,7 +245,8 @@ def ingest_core(
       now_hour: scalar int32 — "now" for the expiry filter (the
         reference filters ``NotAfter.Before(now)``).
       base_hour: scalar int32 — meta-word epoch base.
-      cn_prefixes/cn_prefix_lens: uint8[P, K]/int32[P]; P == 0 disables
+      cn_prefixes/cn_prefix_lens: uint8[P, K]/int32[P, 2]
+        (device-comparable length, true length); P == 0 disables
         the CN filter (shape is static ⇒ config changes recompile once).
     """
     lanes = local_lanes(

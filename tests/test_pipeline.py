@@ -20,7 +20,7 @@ UTC = datetime.timezone.utc
 NOW = datetime.datetime(2024, 6, 1, tzinfo=UTC)
 NOW_HOUR = int(NOW.timestamp()) // 3600
 BASE = packing.DEFAULT_BASE_HOUR
-NO_PREFIX = (np.zeros((0, 32), np.uint8), np.zeros((0,), np.int32))
+NO_PREFIX = (np.zeros((0, 32), np.uint8), np.zeros((0, 2), np.int32))
 
 
 def run_step(table, entries, prefixes=NO_PREFIX, batch_size=None):
@@ -112,10 +112,38 @@ def test_cn_prefix_filter():
     prefixes = np.zeros((2, 32), np.uint8)
     for i, pfx in enumerate([b"KeepMe", b"Other"]):
         prefixes[i, : len(pfx)] = np.frombuffer(pfx, np.uint8)
-    plens = np.array([6, 5], np.int32)
+    plens = np.array([[6, 6], [5, 5]], np.int32)  # (device len, true len)
     table, out = run_step(table, [(keep, 0), (drop, 0)], prefixes=(prefixes, plens))
     assert list(np.asarray(out.filtered_cn)) == [False, True]
     assert list(np.asarray(out.was_unknown)) == [True, False]
+
+
+def test_cn_prefix_longer_than_device_window_routes_host():
+    """A configured prefix longer than the device-comparable window
+    must never be silently decided on its truncated head: lanes whose
+    CN matches the head go to the exact host lane; lanes that do not
+    match the head are filtered on device as usual."""
+    from ct_mapreduce_tpu.ops import der_kernel as dk
+
+    cap = dk.MAX_FIXED_WINDOW_BYTES
+    # 64 chars: the longest legal CN (ub-common-name), > the 61-byte
+    # device-comparable cap.
+    long_pfx = ("KeepMe CA " + "x" * 64)[:64]
+    assert len(long_pfx) > cap
+    matching_cn = long_pfx  # head matches; host must decide the tail
+    other_cn = "DropMe CA 1"  # device decides: filtered
+    table = hashtable.make_table(1 << 12)
+    m = make_cert(serial=310, is_ca=False, issuer_cn=matching_cn)
+    d = make_cert(serial=311, is_ca=False, issuer_cn=other_cn)
+    enc = long_pfx.encode()
+    prefixes = np.frombuffer(enc[:cap], np.uint8)[None, :].copy()
+    plens = np.array([[cap, len(enc)]], np.int32)
+    table, out = run_step(table, [(m, 0), (d, 0)], prefixes=(prefixes, plens))
+    # Lane 0: undecidable on device -> host lane, not filtered, not
+    # device-inserted.
+    assert list(np.asarray(out.host_lane)) == [True, False]
+    assert list(np.asarray(out.filtered_cn)) == [False, True]
+    assert list(np.asarray(out.was_unknown)) == [False, False]
 
 
 def test_host_lane_on_garbage():

@@ -178,7 +178,7 @@ def test_sharded_parity_with_single_chip(certs):
     out_sh = sd.step(data, length, issuer_idx, valid, NOW_HOUR)
 
     table = hashtable.make_table(1 << 13)
-    no_pfx = (np.zeros((0, 32), np.uint8), np.zeros((0,), np.int32))
+    no_pfx = (np.zeros((0, 32), np.uint8), np.zeros((0, 2), np.int32))
     table, out_1c = pipeline.ingest_step(
         table, data, length, issuer_idx, valid,
         np.int32(NOW_HOUR), np.int32(packing.DEFAULT_BASE_HOUR),

@@ -37,7 +37,7 @@ def test_fused_step_on_hardware():
     table, out = step(
         table, datas[0], lens[0], issuer_idx, valid,
         jnp.int32(500_000), jnp.int32(packing.DEFAULT_BASE_HOUR),
-        jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0,), jnp.int32),
+        jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0, 2), jnp.int32),
     )
     wu = np.asarray(out.was_unknown)
     assert wu.sum() == batch  # every lane unique → all fresh inserts
@@ -48,7 +48,7 @@ def test_fused_step_on_hardware():
     table, out2 = step(
         table, datas[0], lens[0], issuer_idx, valid,
         jnp.int32(500_000), jnp.int32(packing.DEFAULT_BASE_HOUR),
-        jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0,), jnp.int32),
+        jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0, 2), jnp.int32),
     )
     assert int(np.asarray(out2.was_unknown).sum()) == 0
     assert int(np.asarray(table.count)) == batch
@@ -108,7 +108,7 @@ def test_fused_step_parity_at_production_width():
     table, out = step(
         table, datas[0], lens[0], issuer_idx, valid,
         jnp.int32(500_000), jnp.int32(packing.DEFAULT_BASE_HOUR),
-        jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0,), jnp.int32),
+        jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0, 2), jnp.int32),
     )
     assert int(np.asarray(out.was_unknown).sum()) == batch
     assert not np.asarray(out.host_lane).any()

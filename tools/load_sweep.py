@@ -77,7 +77,7 @@ def main() -> int:
             table, out = pipeline.ingest_core(
                 table, data, lens[0], issuer_idx, valid,
                 jnp.int32(now_hour), jnp.int32(packing.DEFAULT_BASE_HOUR),
-                jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0,), jnp.int32),
+                jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0, 2), jnp.int32),
             )
             return table, fresh_acc + out.was_unknown.sum().astype(jnp.int32)
 

@@ -131,7 +131,7 @@ def main():
             return pipeline.ingest_core(
                 tbl2, d, ln, ii, vd,
                 jnp.int32(500_000), jnp.int32(packing.DEFAULT_BASE_HOUR),
-                jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0,), jnp.int32))
+                jnp.zeros((0, 32), jnp.uint8), jnp.zeros((0, 2), jnp.int32))
 
         fused_j = jax.jit(fused, donate_argnums=(0,))
         tbl2 = hashtable.make_table(cap)
