@@ -30,7 +30,35 @@ from jax.experimental import pallas as pl
 
 from ct_mapreduce_tpu.ops.sha256 import _H0, _K
 
-LANE_TILE = 512  # lanes per grid step: 4 VPU lane-groups wide
+# Lanes per grid step. The r03 hardware number (0.50 ms @ 16,384 lanes)
+# sits ~30x above the VPU's theoretical throughput for 64 unrolled
+# rounds, which smells like per-grid-step overhead — CTMR_SHA_TILE
+# exists so tools/sha_sweep.py can measure the tile curve on hardware
+# (VMEM comfortably fits tiles up to ~16K: [16, T] block + [8, T] out
+# + ~24 live [T] vectors ≈ 2.9 MB at T=8192).
+LANE_TILE = 512  # shipped default: the r03-measured configuration
+
+
+def lane_tile() -> int:
+    """Effective lanes-per-grid-step: CTMR_SHA_TILE env override, else
+    LANE_TILE (consumed by the sha256 dispatch gate too)."""
+    import os
+
+    raw = os.environ.get("CTMR_SHA_TILE", "")
+    if not raw:
+        return LANE_TILE
+    try:
+        tile = int(raw)
+        if tile < 128 or tile % 128:
+            raise ValueError
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"ignoring CTMR_SHA_TILE={raw!r} (want a multiple of 128); "
+            f"using {LANE_TILE}", stacklevel=2)
+        return LANE_TILE
+    return tile
 
 
 def _rotr(x, n: int):
@@ -110,13 +138,12 @@ def _kernel_looped(k_ref, h0_ref, block_ref, out_ref):
     out_ref[:] = init + state
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def sha256_single_block_pallas(
-    block: jax.Array, interpret: bool = False
+@functools.partial(jax.jit, static_argnames=("interpret", "tile"))
+def _single_block_pallas(
+    block: jax.Array, interpret: bool = False, tile: int = LANE_TILE
 ) -> jax.Array:
-    """uint32[B, 16] pre-padded block → uint32[B, 8] digest."""
     b = block.shape[0]
-    tile = min(LANE_TILE, b)
+    tile = min(tile, b)
     if b % tile:
         raise ValueError(f"batch {b} must divide by the lane tile {tile}")
     blk_t = block.astype(jnp.uint32).T  # [16, B]
@@ -137,6 +164,20 @@ def sha256_single_block_pallas(
         blk_t,
     )
     return out.T
+
+
+def sha256_single_block_pallas(
+    block: jax.Array, interpret: bool = False, tile: int | None = None
+) -> jax.Array:
+    """uint32[B, 16] pre-padded block → uint32[B, 8] digest.
+
+    ``tile`` overrides the lanes-per-grid-step (default: CTMR_SHA_TILE
+    env var, else LANE_TILE); must be a positive multiple of 128."""
+    if tile is None:
+        tile = lane_tile()
+    elif tile < 128 or tile % 128:
+        raise ValueError(f"tile must be a multiple of 128, got {tile}")
+    return _single_block_pallas(block, interpret=interpret, tile=tile)
 
 
 def sha256_fingerprint64_pallas(

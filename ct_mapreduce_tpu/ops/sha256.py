@@ -171,7 +171,9 @@ def _pallas_enabled(batch: int) -> bool:
         return False
     from ct_mapreduce_tpu.ops import pallas_sha256
 
-    tile = min(pallas_sha256.LANE_TILE, batch)
+    if batch == 0:
+        return False  # empty shard: the XLA path handles [0, 16] fine
+    tile = min(pallas_sha256.lane_tile(), batch)
     return batch % tile == 0
 
 

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-4 third-stage measurement ladder: runs AFTER ladder2 (waits for
+# its "ladder2 done" marker; or for the pool directly if ladder2 isn't
+# running). Measures the session's later additions:
+#   - sha_sweep: Pallas SHA-256 lanes-per-grid-step curve + XLA point
+#   - bench.py at 2^22 lanes (batch-width sweep, one step past 2^21)
+#   - microbench at 2^20 (per-stage costs of the reworked walker)
+# Never SIGTERM a mid-claim python process; claims error on their own.
+#
+#   nohup tools/measure_ladder3.sh >/dev/null 2>&1 &
+#   tail -f /tmp/tpu_session3.log
+cd "$(dirname "$0")/.."
+log=${CT_LADDER3_LOG:-/tmp/tpu_session3.log}
+prev=${CT_LADDER2_LOG:-/tmp/tpu_session2.log}
+echo "=== ladder3 start $(date) ===" >> "$log"
+
+if pgrep -f measure_ladder2.sh >/dev/null 2>&1; then
+  echo "waiting for ladder2 ($prev)" >> "$log"
+  while pgrep -f measure_ladder2.sh >/dev/null 2>&1 \
+        && ! grep -q "=== ladder2 done" "$prev" 2>/dev/null; do
+    sleep 60
+  done
+  echo "ladder2 done $(date)" >> "$log"
+else
+  while true; do
+    python tools/probe_pool.py >> "$log" 2>&1
+    if [ $? -eq 0 ]; then break; fi
+    echo "--- still down $(date) ---" >> "$log"
+    sleep 45
+  done
+fi
+
+echo "=== running ladder3 $(date) ===" >> "$log"
+echo "--- sha_sweep 2^20 ---" >> "$log"
+timeout 1800 python tools/sha_sweep.py >> "$log" 2>&1
+echo "--- microbench 1048576 (reworked walker) ---" >> "$log"
+timeout 1500 python tools/microbench.py 1048576 >> "$log" 2>&1
+echo "--- bench 2^22 lanes ---" >> "$log"
+CT_BENCH_BATCH=4194304 CT_BENCH_WATCHDOG_SECS=520 CT_BENCH_E2E=0 \
+  timeout 1200 python bench.py >> "$log" 2>&1
+echo "=== ladder3 done $(date) ===" >> "$log"

@@ -1,0 +1,103 @@
+"""Hardware sweep: Pallas SHA-256 lanes-per-grid-step (CTMR_SHA_TILE).
+
+The r03 measurement (0.50 ms @ 16,384 lanes, tile 512) sits ~30x above
+the VPU's theoretical throughput for the 64 unrolled rounds; if the gap
+is per-grid-step overhead, wider tiles close it. Times the kernel at a
+production batch width across tile sizes, platform rules applied (many
+invocations inside one jitted fori_loop, one synchronous value read;
+a per-iteration block mutation stops XLA hoisting the call).
+
+  python tools/sha_sweep.py [batch] [tile ...]   # defaults: 2^20 lanes,
+                                                 # tiles 512 2048 8192
+"""
+import os
+import sys
+import time
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ct_mapreduce_tpu.ops import pallas_sha256, sha256
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    tiles = [int(t) for t in sys.argv[2:]] or [512, 2048, 8192]
+    reps = int(os.environ.get("CT_SHA_SWEEP_REPS", "16"))
+    interpret = jax.default_backend() != "tpu"
+    if interpret:
+        print("WARNING: no TPU; interpret-mode numbers are meaningless "
+              "as measurements (harness smoke only)", file=sys.stderr)
+        batch, reps, tiles = 1024, 2, [128, 512]
+
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} acquired in {time.perf_counter() - t0:.1f}s; "
+          f"batch={batch} reps={reps}", flush=True)
+    rng = np.random.default_rng(0)
+    block_np = rng.integers(0, 2**32, size=(batch, 16), dtype=np.uint32)
+
+    def timed(tile: int) -> float:
+        @jax.jit
+        def run(block):
+            def body(i, carry):
+                block, acc = carry
+                block = block.at[0, 0].set(i.astype(jnp.uint32))
+                d = pallas_sha256.sha256_fingerprint64_pallas(
+                    block, interpret=interpret
+                )
+                return block, acc + d[0, 0]
+
+            _, acc = jax.lax.fori_loop(
+                0, reps, body, (block, jnp.uint32(0)))
+            return acc
+
+        os.environ["CTMR_SHA_TILE"] = str(tile)
+        blk = jax.device_put(jnp.asarray(block_np))
+        t0 = time.perf_counter()
+        int(run(blk))  # compile + warm
+        warm = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        int(run(blk))
+        dt = time.perf_counter() - t0
+        lanes = batch * reps
+        print(f"tile {tile:6d}: {dt:.3f}s / {lanes} lanes = "
+              f"{dt / lanes * 1e9:6.1f} ns/lane "
+              f"({lanes / dt / 1e6:8.2f}M lanes/s)  [compile+warm {warm:.1f}s]",
+              flush=True)
+        return dt
+
+    # XLA-scan reference point at the same width (one tile value only).
+    @jax.jit
+    def run_xla(block):
+        def body(i, carry):
+            block, acc = carry
+            block = block.at[0, 0].set(i.astype(jnp.uint32))
+            d = sha256.sha256_single_block(block)[..., 4:]
+            return block, acc + d[0, 0]
+
+        _, acc = jax.lax.fori_loop(0, reps, body, (block, jnp.uint32(0)))
+        return acc
+
+    for tile in tiles:
+        timed(tile)
+    blk = jax.device_put(jnp.asarray(block_np))
+    t0 = time.perf_counter()
+    int(run_xla(blk))
+    warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    int(run_xla(blk))
+    dt = time.perf_counter() - t0
+    lanes = batch * reps
+    print(f"xla scan   : {dt:.3f}s / {lanes} lanes = "
+          f"{dt / lanes * 1e9:6.1f} ns/lane  [compile+warm {warm:.1f}s]",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
