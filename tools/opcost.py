@@ -99,6 +99,32 @@ def main():
         say(f"cap 2^{log2cap} ({mb:5.0f}MB): scatter-row  "
             f"{dt * 1e3:7.3f} ms/op")
 
+        # Fused-row read path: gather uint32[cap, 5] rows then slice
+        # the 4 key words (exactly hashtable._probe_window's access) vs
+        # gathering through a pre-sliced [:, :4] view — answers whether
+        # XLA narrows the gather or fetches the dead meta word (and
+        # whether the view formulation materializes a 4/5-size copy).
+        def mk_table5():
+            return (jnp.zeros((cap, 5), jnp.uint32), jnp.uint32(0))
+
+        def g5_body(i, c):
+            t, acc = c
+            cur = t[(slots + i) & (cap - 1)][..., :4]
+            return t, acc + cur.sum(dtype=jnp.uint32)
+
+        dt, _ = loop_time(g5_body, mk_table5)
+        say(f"cap 2^{log2cap} ({mb * 5 / 4:5.0f}MB): gather5-slice4 "
+            f"{dt * 1e3:7.3f} ms/op")
+
+        def g5v_body(i, c):
+            t, acc = c
+            cur = t[:, :4][(slots + i) & (cap - 1)]
+            return t, acc + cur.sum(dtype=jnp.uint32)
+
+        dt, _ = loop_time(g5v_body, mk_table5)
+        say(f"cap 2^{log2cap} ({mb * 5 / 4:5.0f}MB): view4-gather   "
+            f"{dt * 1e3:7.3f} ms/op")
+
         # scatter-min on int32[cap]
         def mk_claim():
             return (jnp.full((cap,), 2**31 - 1, jnp.int32),)
