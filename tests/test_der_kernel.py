@@ -237,6 +237,54 @@ def test_serial_ceiling_46_bytes():
     assert not bool(fits[1])
 
 
+def test_row_pass_budget():
+    """Structural guard for the walker's row-pass economy: each
+    ``_window`` / ``_sup_fetch`` call site costs ~one HBM row pass at
+    production widths, so the trace-time call counts are pinned —
+    a regression reintroducing per-header windows fails here long
+    before a hardware benchmark would catch it."""
+    import jax
+    import jax.numpy as jnp
+
+    calls = {"window": 0, "sup": 0}
+    real_window, real_sup = der_kernel._window, der_kernel._sup_fetch
+
+    def count_window(*a, **k):
+        calls["window"] += 1
+        return real_window(*a, **k)
+
+    def count_sup(*a, **k):
+        calls["sup"] += 1
+        return real_sup(*a, **k)
+
+    der_kernel._window, der_kernel._sup_fetch = count_window, count_sup
+    try:
+        data = jnp.zeros((8, 1024), jnp.uint8)
+        length = jnp.full((8,), 1000, jnp.int32)
+        jax.eval_shape(
+            lambda d, l: der_kernel.parse_certs_rows(
+                der_kernel.pack_rows(d), l, scan_issuer_cn=False
+            ),
+            data, length,
+        )
+        # Fixed walk: window 1 (cert..algHdr), issuer hdr, validity +
+        # subject, SPKI hdr, UIDs + extensions = 5 windows; extension
+        # scan = 1 superblock fetch site (re-executed, not re-traced,
+        # per outer round).
+        assert calls == {"window": 5, "sup": 1}, calls
+        calls["window"] = calls["sup"] = 0
+        jax.eval_shape(
+            lambda d, l: der_kernel.parse_certs_rows(
+                der_kernel.pack_rows(d), l, scan_issuer_cn=True
+            ),
+            data, length,
+        )
+        # + the RDN scan's one superblock fetch site.
+        assert calls == {"window": 5, "sup": 2}, calls
+    finally:
+        der_kernel._window, der_kernel._sup_fetch = real_window, real_sup
+
+
 def test_serial_gather():
     ders = fixture_certs()
     data, length = pack(ders)
