@@ -400,8 +400,10 @@ def _scan_issuer_cn(rows: _Rows, name_off, name_end, hdr_ok0):
     Name ::= SEQUENCE OF RelativeDistinguishedName;
     RDN ::= SET OF AttributeTypeAndValue;
     ATV ::= SEQUENCE { type OID, value ANY }.
-    Returns (cn_off, cn_len) with len 0 when absent. Early-exits once
-    every lane has left its Name window (typical: 3–6 RDNs).
+    Returns (cn_off, cn_len) with len 0 when absent. Runs as a
+    superblock loop (see _scan_extensions): one row pass fetches each
+    lane 512 bytes; a typical issuer Name (3–6 RDNs, tens of bytes)
+    scans in a single fetch.
     """
     b = name_off.shape[0]
     zero = jnp.zeros((b,), jnp.int32)
