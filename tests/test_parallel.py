@@ -276,6 +276,38 @@ def test_checkpoint_atomic_and_exact_path(tmp_path):
     assert restored.drain().counts == agg.drain().counts
 
 
+@pytest.mark.timeout(580)
+def test_sixteen_device_virtual_mesh():
+    """Scale the full multichip dryrun (binding dispatch caps,
+    host-lane spills, exact totals, growth guard) to a 16-device
+    virtual mesh — twice the width every other test uses. Subprocess:
+    the parent's jax is pinned to 8 devices."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env.pop("CT_TPU_TESTS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(repo)
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import importlib.util\n"
+        f"spec = importlib.util.spec_from_file_location('ge', {str(repo / '__graft_entry__.py')!r})\n"
+        "ge = importlib.util.module_from_spec(spec); spec.loader.exec_module(ge)\n"
+        "ge.dryrun_multichip(16)\n"
+        "print('OK16')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK16" in proc.stdout, (proc.stdout, proc.stderr[-500:])
+
+
 def test_pre_cursor_save_not_starved_by_other_logs():
     """A periodic cursor save for log A must not wait on log B's
     in-flight entries (the old global entry_queue.join() could be
