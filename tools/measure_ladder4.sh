@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-4 master ladder, VALUE-ORDERED: after three pool-outage rounds,
+# assume any hardware window may close early — measure the round's
+# highest-stakes numbers first so a short window still decides the
+# roofline. Order:
+#   1. bench.py default (2^20 lanes, fused rows, full e2e + parity)
+#        — the headline + the driver-shaped number + e2e breakdown
+#   2. microbench 2^20 — per-stage costs of the reworked walker
+#        (decides the 8.3M/s prediction in ARCHITECTURE.md)
+#   3. bench 2^22 / 2^21 — batch-width sweep
+#   4. load_sweep — insert throughput at 10/25/50/75% table load
+#   5. CT_TPU_TESTS hardware tier (5 tests)
+#   6. secondary probes: insert_sweep, opcost, sha_sweep, mosaic_probe
+# Never SIGTERM a mid-claim python process; claims error on their own
+# (~25 min during an outage).
+#
+#   nohup tools/measure_ladder4.sh >/dev/null 2>&1 &
+#   tail -f /tmp/tpu_session4.log
+cd "$(dirname "$0")/.."
+log=${CT_LADDER4_LOG:-/tmp/tpu_session4.log}
+echo "=== ladder4 start $(date) ===" >> "$log"
+while true; do
+  python tools/probe_pool.py >> "$log" 2>&1
+  if [ $? -eq 0 ]; then break; fi
+  echo "--- still down $(date) ---" >> "$log"
+  sleep 45
+done
+echo "=== pool up $(date); running value-ordered ladder ===" >> "$log"
+
+echo "--- [1] bench default (2^20 fused rows, full e2e) ---" >> "$log"
+CT_BENCH_WATCHDOG_SECS=520 timeout 1200 python bench.py >> "$log" 2>&1
+echo "--- [2] microbench 1048576 (reworked walker) ---" >> "$log"
+timeout 1500 python tools/microbench.py 1048576 >> "$log" 2>&1
+echo "--- [3a] bench 2^22 lanes ---" >> "$log"
+CT_BENCH_BATCH=4194304 CT_BENCH_WATCHDOG_SECS=520 CT_BENCH_E2E=0 \
+  timeout 1200 python bench.py >> "$log" 2>&1
+echo "--- [3b] bench 2^21 lanes ---" >> "$log"
+CT_BENCH_BATCH=2097152 CT_BENCH_WATCHDOG_SECS=520 CT_BENCH_E2E=0 \
+  timeout 1200 python bench.py >> "$log" 2>&1
+echo "--- [4] load_sweep 24 ---" >> "$log"
+timeout 3000 python tools/load_sweep.py 24 0.10 0.25 0.50 0.75 >> "$log" 2>&1
+echo "--- [5] hardware test tier ---" >> "$log"
+CT_TPU_TESTS=1 timeout 2400 python -m pytest tests/test_tpu_hw.py -v >> "$log" 2>&1
+echo "--- [6a] insert_sweep ---" >> "$log"
+timeout 3000 python tools/insert_sweep.py >> "$log" 2>&1
+echo "--- [6b] opcost 131072 ---" >> "$log"
+timeout 1500 python tools/opcost.py 131072 >> "$log" 2>&1
+echo "--- [6c] sha_sweep ---" >> "$log"
+timeout 1800 python tools/sha_sweep.py >> "$log" 2>&1
+echo "--- [6d] mosaic_probe compiled ---" >> "$log"
+timeout 1800 python tools/mosaic_probe.py >> "$log" 2>&1
+echo "--- [6e] bench PROBE_WIDTH=8 ---" >> "$log"
+CTMR_PROBE_WIDTH=8 CT_BENCH_WATCHDOG_SECS=520 CT_BENCH_E2E=0 \
+  timeout 1200 python bench.py >> "$log" 2>&1
+echo "=== ladder4 done $(date) ===" >> "$log"

@@ -1,0 +1,212 @@
+"""Honest per-stage cost of the fused step, bench-methodology edition.
+
+tools/microbench.py times stages with `jax.block_until_ready`, which
+this stack does not reliably honor (BENCHLOG round-1 postmortem) — its
+per-stage numbers can be off by orders of magnitude (0.18 ms for a
+1 GB parse = 5.8 TB/s, 7x the chip's HBM). This probe times each stage
+the way bench.py times the headline: the stage runs inside a jitted
+`lax.fori_loop` sweep whose input is re-stamped per sweep (so nothing
+is loop-invariant), accumulates a scalar that depends on every stage
+output (so nothing is dead), and every chunk ends with a synchronous
+device-value read. Stage deltas then give real per-stage costs:
+
+  read    — one full HBM pass over the stamped uint8[B, L] batch
+  pack    — + word-pack into uint32 rows
+  parse   — + the DER walker (offsets, lengths, flags)
+  serial  — + serial TLV gather to uint8[B, 46]
+  sha     — + fingerprint block build + SHA-256
+  lanes   — the full communication-free prefix (local_lanes)
+  full    — ingest_core (adds the dedup-table insert, donated state)
+
+Run:  python tools/stagecost.py [batch] [stage ...]
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def say(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from ct_mapreduce_tpu.core import packing
+    from ct_mapreduce_tpu.ops import der_kernel, hashtable, pipeline
+    from ct_mapreduce_tpu.utils import syncerts
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    only = set(sys.argv[2:])
+    pad_len = int(os.environ.get("CT_SC_PADLEN", "1024"))
+    cap = 1 << int(os.environ.get("CT_SC_LOG2_CAP", "26"))
+    exec_target_s = float(os.environ.get("CT_SC_EXEC_SECS", "4.0"))
+
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    say(f"device: {dev.platform} ({dev.device_kind}) acquired in "
+        f"{time.perf_counter() - t0:.1f}s; batch={batch} pad={pad_len}")
+
+    tpl = syncerts.make_template()
+    datas, lens = syncerts.build_device_batches(tpl, 1, batch, pad_len)
+    issuer_idx = jax.device_put(np.zeros((batch,), np.int32))
+    valid = jax.device_put(np.ones((batch,), bool))
+    epoch_cols = tpl.serial_off + np.arange(4, 8, dtype=np.int32)
+    now_hour = 500_000
+    no_cn = np.zeros((0, 32), np.uint8)
+    no_cn_lens = np.zeros((0, 2), np.int32)
+
+    def stamp(data, e):
+        eb = jnp.stack(
+            [(e >> 24) & 0xFF, (e >> 16) & 0xFF, (e >> 8) & 0xFF, e & 0xFF]
+        ).astype(jnp.uint8)
+        return data.at[:, epoch_cols].set(eb[None, :])
+
+    # Each stage maps the stamped batch to a uint32 scalar that depends
+    # on every output it claims to compute (keeps the work live under
+    # DCE while adding only a reduce).
+    def s_read(data, length):
+        return data.astype(jnp.uint32).sum()
+
+    def s_pack(data, length):
+        return der_kernel.pack_rows(data).words.sum()
+
+    def _parse(data, length):
+        rows = der_kernel.pack_rows(data)
+        p = der_kernel.parse_certs_rows(rows, length, scan_issuer_cn=False)
+        return rows, p
+
+    def s_parse(data, length):
+        _, p = _parse(data, length)
+        return (
+            p.serial_off + p.serial_len + p.not_after_hour
+            + p.ok.astype(jnp.int32) + p.is_ca.astype(jnp.int32)
+            + p.crldp_off + p.issuer_off
+        ).astype(jnp.uint32).sum()
+
+    def s_serial(data, length):
+        rows, p = _parse(data, length)
+        serials, fits = der_kernel.gather_serials_rows(
+            rows, p.serial_off, p.serial_len, packing.MAX_SERIAL_BYTES)
+        return (serials.astype(jnp.uint32).sum()
+                + fits.astype(jnp.uint32).sum() + p.not_after_hour.sum())
+
+    def s_sha(data, length):
+        rows, p = _parse(data, length)
+        serials, fits = der_kernel.gather_serials_rows(
+            rows, p.serial_off, p.serial_len, packing.MAX_SERIAL_BYTES)
+        fps = pipeline.fingerprints(
+            issuer_idx, p.not_after_hour, serials, p.serial_len)
+        return fps.sum() + fits.astype(jnp.uint32).sum()
+
+    def s_lanes(data, length):
+        lanes = pipeline.local_lanes(
+            data, length, issuer_idx, valid, jnp.int32(now_hour),
+            jnp.int32(packing.DEFAULT_BASE_HOUR), no_cn, no_cn_lens,
+            packing.MAX_ISSUERS)
+        return (lanes.fps.sum() + lanes.meta.sum()
+                + lanes.insertable.astype(jnp.uint32).sum()
+                + lanes.serials.astype(jnp.uint32).sum())
+
+    def run_stage(name, stage_fn):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def mega(acc, n_sweeps, datas, lens):
+            def body(s, acc):
+                data = stamp(datas[0], acc % jnp.uint32(1 << 20)
+                             + jnp.uint32(s))
+                return acc + stage_fn(data, lens[0])
+            return jax.lax.fori_loop(0, n_sweeps, body, acc)
+
+        fetch = jax.jit(lambda a: a + jnp.uint32(0))
+        acc = jax.device_put(np.uint32(0))
+        t0 = time.perf_counter()
+        acc = mega(acc, np.int32(1), datas, lens)
+        int(fetch(acc))
+        say(f"  {name}: compile+warmup {time.perf_counter() - t0:.1f}s")
+        t0 = time.perf_counter()
+        acc = mega(acc, np.int32(1), datas, lens)
+        int(fetch(acc))
+        per_sweep = max(time.perf_counter() - t0, 1e-4)
+        n = max(2, min(int(exec_target_s / per_sweep), 200))
+        t0 = time.perf_counter()
+        acc = mega(acc, np.int32(n), datas, lens)
+        int(fetch(acc))
+        dt = (time.perf_counter() - t0) / n
+        say(f"{name:7s} {dt * 1e3:9.2f} ms/sweep  "
+            f"{dt / batch * 1e9:8.1f} ns/entry  ({n} sweeps)")
+        return dt
+
+    def run_full():
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def mega(table, acc, n_sweeps, datas, lens, issuer_idx, valid):
+            def body(s, carry):
+                table, acc = carry
+                data = stamp(datas[0], acc + jnp.uint32(s))
+                table, out = pipeline.ingest_core(
+                    table, data, lens[0], issuer_idx, valid,
+                    jnp.int32(now_hour),
+                    jnp.int32(packing.DEFAULT_BASE_HOUR), no_cn, no_cn_lens)
+                return table, acc + out.was_unknown.sum().astype(jnp.uint32)
+            return jax.lax.fori_loop(0, n_sweeps, body, (table, acc))
+
+        fetch = jax.jit(lambda a: a + jnp.uint32(0))
+        table = hashtable.make_table(cap)
+        acc = jax.device_put(np.uint32(0))
+        t0 = time.perf_counter()
+        table, acc = mega(table, acc, np.int32(1), datas, lens,
+                          issuer_idx, valid)
+        int(fetch(acc))
+        say(f"  full: compile+warmup {time.perf_counter() - t0:.1f}s")
+        t0 = time.perf_counter()
+        table, acc = mega(table, acc, np.int32(1), datas, lens,
+                          issuer_idx, valid)
+        int(fetch(acc))
+        per_sweep = max(time.perf_counter() - t0, 1e-4)
+        budget = max(1, int(cap * 0.5) // batch - 3)
+        n = max(2, min(int(exec_target_s / per_sweep), budget, 200))
+        t0 = time.perf_counter()
+        table, acc = mega(table, acc, np.int32(n), datas, lens,
+                          issuer_idx, valid)
+        int(fetch(acc))
+        dt = (time.perf_counter() - t0) / n
+        say(f"{'full':7s} {dt * 1e3:9.2f} ms/sweep  "
+            f"{dt / batch * 1e9:8.1f} ns/entry  ({n} sweeps)")
+        return dt
+
+    stages = [
+        ("read", s_read), ("pack", s_pack), ("parse", s_parse),
+        ("serial", s_serial), ("sha", s_sha), ("lanes", s_lanes),
+    ]
+    results = {}
+    for name, fn in stages:
+        if only and name not in only:
+            continue
+        results[name] = run_stage(name, fn)
+    if not only or "full" in only:
+        results["full"] = run_full()
+
+    order = [n for n, _ in stages] + ["full"]
+    got = [n for n in order if n in results]
+    say("")
+    say("stage deltas (cost of each added phase):")
+    prev = 0.0
+    for n in got:
+        d = results[n] - prev
+        say(f"  +{n:7s} {d * 1e3:9.2f} ms  {d / batch * 1e9:8.1f} ns/entry")
+        prev = results[n]
+
+
+if __name__ == "__main__":
+    main()
