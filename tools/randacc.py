@@ -1,0 +1,170 @@
+"""Honest cost of TPU random-access primitives at insert shapes.
+
+stagecost.py showed the dedup insert owns ~85% of the fused step
+(~710 of ~840 ns/entry at 2^20 lanes), and the insert is built from
+exactly four random-access primitives. This probe times each primitive
+standalone — same trusted contract as bench.py/stagecost.py (per-sweep
+varying indices inside a jitted fori_loop, synchronous value read) —
+so the insert redesign is driven by measured op costs, not folklore:
+
+  g_scalar  — uint32[B] gather from uint32[cap]        (SoA probe read)
+  g_row5    — uint32[B, 5] row gather from [cap, 5]    (current fused row)
+  g_row128  — uint32[B, 128] block gather from [cap/128, 128]
+              (bucketed design: one dense 512 B block per lane)
+  s_scalar  — [B] scatter-min into [cap]               (claim election)
+  s_row5    — [B, 5] row scatter into [cap, 5]         (current commit)
+  s_row128  — [B, 128] block scatter into [cap/128, 128]
+  sort1     — jnp.sort of uint32[B]
+  sort_kv   — lax.sort of (uint32[B] keys, int32[B] payload)
+  sort4     — lax.sort of 4-word keys + payload (full 128-bit lexsort)
+
+Run:  python tools/randacc.py [batch] [log2_cap] [name ...]
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def say(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    log2_cap = int(sys.argv[2]) if len(sys.argv) > 2 else 26
+    only = set(sys.argv[3:])
+    cap = 1 << log2_cap
+    exec_target_s = float(os.environ.get("CT_RA_EXEC_SECS", "4.0"))
+
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    say(f"device: {dev.platform} ({dev.device_kind}) acquired in "
+        f"{time.perf_counter() - t0:.1f}s; batch={batch} cap=2^{log2_cap}")
+
+    # Index stream: a cheap per-sweep LCG keeps indices varying (no
+    # loop-invariant hoisting) and uniformly spread over the table.
+    lane = jax.device_put(np.arange(batch, dtype=np.uint32))
+    # Table factories, not shared arrays: each case DONATES its tables,
+    # so sharing one buffer across cases would hand later cases a
+    # deleted array.
+    mk_t1 = lambda: jax.device_put(np.zeros((cap,), np.uint32))
+    mk_t5 = lambda: jax.device_put(np.zeros((cap, 5), np.uint32))
+    mk_tb = lambda: jax.device_put(np.zeros((cap // 128, 128), np.uint32))
+
+    def idx(seed):
+        h = (lane * np.uint32(0x9E3779B9)) ^ seed
+        h = h * np.uint32(0x85EBCA6B)
+        return (h & np.uint32(cap - 1)).astype(jnp.int32)
+
+    def bidx(seed):
+        h = (lane * np.uint32(0x9E3779B9)) ^ seed
+        h = h * np.uint32(0x85EBCA6B)
+        return (h & np.uint32(cap // 128 - 1)).astype(jnp.int32)
+
+    # Each case: (name, tables_in, body(seed, *tables) -> (tables, scalar)).
+    def g_scalar(seed, t1):
+        return (t1,), t1[idx(seed)].sum()
+
+    def g_row5(seed, t5):
+        return (t5,), t5[idx(seed)].sum()
+
+    def g_row128(seed, tb):
+        return (tb,), tb[bidx(seed)].sum()
+
+    def s_scalar(seed, t1):
+        t1 = t1.at[idx(seed)].min(lane)
+        return (t1,), t1[0]
+
+    def s_row5(seed, t5):
+        rows = jnp.tile(lane[:, None], (1, 5))
+        t5 = t5.at[idx(seed)].set(rows)
+        return (t5,), t5[0].sum()
+
+    def s_row128(seed, tb):
+        rows = jnp.tile(lane[:, None], (1, 128))
+        tb = tb.at[bidx(seed)].set(rows)
+        return (tb,), tb[0].sum()
+
+    def sort1(seed, t1):
+        h = (lane * np.uint32(0x9E3779B9)) ^ seed
+        return (t1,), jnp.sort(h)[0] + jnp.uint32(0)
+
+    def sort_kv(seed, t1):
+        h = (lane * np.uint32(0x9E3779B9)) ^ seed
+        k, v = jax.lax.sort((h, lane.astype(jnp.int32)), num_keys=1)
+        return (t1,), k[0] + v[0].astype(jnp.uint32)
+
+    def sort4(seed, t1):
+        h0 = (lane * np.uint32(0x9E3779B9)) ^ seed
+        h1 = h0 * np.uint32(0x85EBCA6B)
+        h2 = h1 ^ (h0 >> 13)
+        h3 = h2 * np.uint32(0xC2B2AE35)
+        out = jax.lax.sort(
+            (h0, h1, h2, h3, lane.astype(jnp.int32)), num_keys=4)
+        return (t1,), out[0][0] + out[4][0].astype(jnp.uint32)
+
+    cases = {
+        "g_scalar": (g_scalar, (mk_t1,)),
+        "g_row5": (g_row5, (mk_t5,)),
+        "g_row128": (g_row128, (mk_tb,)),
+        "s_scalar": (s_scalar, (mk_t1,)),
+        "s_row5": (s_row5, (mk_t5,)),
+        "s_row128": (s_row128, (mk_tb,)),
+        "sort1": (sort1, (mk_t1,)),
+        "sort_kv": (sort_kv, (mk_t1,)),
+        "sort4": (sort4, (mk_t1,)),
+    }
+
+    for name, (body, mk_tabs) in cases.items():
+        if only and name not in only:
+            continue
+        tabs = tuple(mk() for mk in mk_tabs)
+
+        @functools.partial(jax.jit, donate_argnums=tuple(range(len(tabs))))
+        def mega(*args, _body=body, _n=len(tabs)):
+            tabs_in, acc, n_sweeps = args[:_n], args[_n], args[_n + 1]
+
+            def sweep(s, carry):
+                tabs_c, acc = carry
+                tabs_c, v = _body(acc + jnp.uint32(s), *tabs_c)
+                return tabs_c, acc + v.astype(jnp.uint32)
+
+            tabs_out, acc = jax.lax.fori_loop(
+                0, n_sweeps, sweep, (tuple(tabs_in), acc))
+            return tabs_out, acc
+
+        fetch = jax.jit(lambda a: a + jnp.uint32(0))
+        acc = jax.device_put(np.uint32(0))
+        t0 = time.perf_counter()
+        tabs, acc = mega(*tabs, acc, np.int32(1))
+        int(fetch(acc))
+        say(f"  {name}: compile+warmup {time.perf_counter() - t0:.1f}s")
+        t0 = time.perf_counter()
+        tabs, acc = mega(*tabs, acc, np.int32(1))
+        int(fetch(acc))
+        per = max(time.perf_counter() - t0, 1e-4)
+        n = max(2, min(int(exec_target_s / per), 400))
+        t0 = time.perf_counter()
+        tabs, acc = mega(*tabs, acc, np.int32(n))
+        int(fetch(acc))
+        dt = (time.perf_counter() - t0) / n
+        say(f"{name:9s} {dt * 1e3:9.3f} ms  {dt / batch * 1e9:8.2f} ns/elem "
+            f" ({n} sweeps)")
+
+
+if __name__ == "__main__":
+    main()

@@ -101,7 +101,8 @@ def main() -> None:
         serials, fits = der_kernel.gather_serials_rows(
             rows, p.serial_off, p.serial_len, packing.MAX_SERIAL_BYTES)
         return (serials.astype(jnp.uint32).sum()
-                + fits.astype(jnp.uint32).sum() + p.not_after_hour.sum())
+                + fits.astype(jnp.uint32).sum()
+                + p.not_after_hour.astype(jnp.uint32).sum())
 
     def s_sha(data, length):
         rows, p = _parse(data, length)
