@@ -53,8 +53,24 @@ import numpy as np
 from ct_mapreduce_tpu.core import der as hostder
 from ct_mapreduce_tpu.core import packing
 from ct_mapreduce_tpu.core.types import ExpDate, Issuer
-from ct_mapreduce_tpu.ops import der_kernel, hashtable, pipeline
+from ct_mapreduce_tpu.ops import buckettable, der_kernel, hashtable, pipeline
 from ct_mapreduce_tpu.telemetry.metrics import incr_counter, set_gauge
+
+
+def _table_layout() -> str:
+    """Dedup-table layout: ``bucket`` (default — the sort-based
+    24-slot-bucket table the round-4 hardware measurements favor by
+    ~an order of magnitude on the insert, ops/buckettable.py) or
+    ``open`` (slot-granular open addressing, ops/hashtable.py)."""
+    layout = os.environ.get("CTMR_TABLE", "bucket").strip().lower()
+    if layout not in ("bucket", "open"):
+        import warnings
+
+        warnings.warn(
+            f"ignoring CTMR_TABLE={layout!r} (want bucket|open); "
+            "using bucket", stacklevel=2)
+        return "bucket"
+    return layout
 
 
 class IssuerRegistry:
@@ -203,7 +219,7 @@ def _reinsert_chunks(table, keys, meta, valid, max_probes: int):
     def run(table, keys, meta, valid, max_probes):
         def body(i, carry):
             table, ovf = carry
-            table, _wu, o = hashtable.insert(
+            table, _wu, o = pipeline.table_insert(
                 table, keys[i], meta[i], valid[i], max_probes=max_probes
             )
             return table, ovf + o.sum(dtype=jnp.int32)
@@ -228,7 +244,9 @@ class TpuAggregator:
         max_capacity: int = 1 << 28,
     ) -> None:
         self.table = self._make_table(capacity)
-        self.capacity = capacity
+        # Bucket tables round capacity up to whole buckets; load-factor
+        # arithmetic must use the real slot count.
+        self.capacity = getattr(self.table, "capacity", capacity)
         self.batch_size = batch_size
         self.base_hour = base_hour
         self.max_probes = max_probes
@@ -283,15 +301,24 @@ class TpuAggregator:
 
     # -- state hooks (overridden by the mesh-sharded subclass) -----------
     def _make_table(self, capacity: int):
+        if _table_layout() == "bucket":
+            return buckettable.make_table(capacity)
         return hashtable.make_table(capacity)
 
     def _drain_table(self) -> tuple[np.ndarray, np.ndarray]:
+        if isinstance(self.table, buckettable.BucketTable):
+            return buckettable.drain_np(self.table)
         return hashtable.drain_np(self.table)
 
     def _device_contains(self, fps: np.ndarray) -> np.ndarray:
         """bool[n]: are these fingerprints present in the device table?"""
         import jax.numpy as jnp
 
+        if isinstance(self.table, buckettable.BucketTable):
+            return np.asarray(
+                buckettable.contains(self.table, jnp.asarray(fps),
+                                     max_probes=self.max_probes),
+            )
         return np.asarray(
             hashtable.contains(self.table, jnp.asarray(fps),
                                max_probes=self.max_probes),
@@ -304,9 +331,10 @@ class TpuAggregator:
 
     def _rebuild_table(self, new_capacity: int) -> int:
         """Fresh empty table at ``new_capacity``; returns the actual
-        capacity (mesh-sharded subclasses may round it)."""
+        capacity (bucket layouts round up to whole buckets,
+        mesh-sharded subclasses round to the mesh)."""
         self.table = self._make_table(new_capacity)
-        return new_capacity
+        return getattr(self.table, "capacity", new_capacity)
 
     def _bulk_reinsert(self, keys: np.ndarray, meta: np.ndarray) -> int:
         """Re-hash drained rows into the (fresh) table; returns the
@@ -939,8 +967,17 @@ class TpuAggregator:
             raise
 
     def _write_npz(self, fh, host_items) -> None:
+        layout = ("bucket" if isinstance(self.table, buckettable.BucketTable)
+                  else "open")
         np.savez_compressed(
             fh,
+            # (keys, meta, count) stays the cross-version wire format;
+            # `layout` records slot positioning (bucket i//SLOTS vs
+            # open-addressed chains) so restore rebuilds the same
+            # structure. Cross-layout restores go through the
+            # reinsertion path (ShardedDedup.bulk_insert_np /
+            # restore_into), which re-hashes rows for any layout.
+            layout=np.array(layout),
             keys=np.asarray(self.table.keys),
             meta=np.asarray(self.table.meta),
             count=np.asarray(self.table.count),
@@ -978,15 +1015,29 @@ class TpuAggregator:
     def load_checkpoint(self, path: str) -> None:
         z = np.load(path, allow_pickle=True)
         # Checkpoint format stays (keys, meta, count) for cross-version
-        # stability; the in-memory table fuses them into one row array.
-        self.table = hashtable.TableState(
-            rows=self._asarray(hashtable.fuse_rows(z["keys"], z["meta"])),
-            count=self._asarray(z["count"]),
-        )
+        # stability; `layout` (absent in pre-round-4 snapshots ⇒ open)
+        # says how slot positions map back to a table structure. The
+        # snapshot's layout wins over CTMR_TABLE: positions are only
+        # meaningful in the structure that wrote them.
+        layout = str(z["layout"]) if "layout" in z else "open"
+        if layout == "bucket":
+            slots = hashtable.fuse_rows(z["keys"], z["meta"])
+            nb = slots.shape[0] // buckettable.SLOTS
+            rows = np.zeros((nb, buckettable.ROW_WORDS), np.uint32)
+            rows[:, : buckettable.SLOTS * 5] = slots.reshape(nb, -1)
+            self.table = buckettable.BucketTable(
+                rows=self._asarray(rows), count=self._asarray(z["count"]),
+            )
+            self.capacity = nb * buckettable.SLOTS
+        else:
+            self.table = hashtable.TableState(
+                rows=self._asarray(hashtable.fuse_rows(z["keys"], z["meta"])),
+                count=self._asarray(z["count"]),
+            )
+            self.capacity = int(z["keys"].shape[0])
         self._device_written = bool(np.asarray(z["count"]).sum() > 0)
         self._table_fill = int(np.asarray(z["count"]).sum())
         self._inflight_lanes = 0
-        self.capacity = int(z["keys"].shape[0])
         self.base_hour = int(z["base_hour"])
         self.registry = IssuerRegistry.from_json(z["registry"].tobytes().decode())
         self.issuer_totals = z["issuer_totals"].copy()
@@ -1019,6 +1070,14 @@ class HostSnapshotAggregator(TpuAggregator):
     """
 
     def _make_table(self, capacity: int):
+        if _table_layout() == "bucket":
+            nb = 1 << max(
+                0, (capacity + buckettable.SLOTS - 1) // buckettable.SLOTS - 1
+            ).bit_length()
+            return buckettable.BucketTable(
+                rows=np.zeros((nb, buckettable.ROW_WORDS), np.uint32),
+                count=np.zeros((), np.int32),
+            )
         if capacity & (capacity - 1):
             raise ValueError(f"capacity must be a power of two, got {capacity}")
         return hashtable.TableState(
@@ -1029,10 +1088,14 @@ class HostSnapshotAggregator(TpuAggregator):
     def _asarray(self, arr: np.ndarray):
         return np.asarray(arr)
 
-    # _drain_table is inherited: hashtable.drain_np is already pure
-    # NumPy over this subclass's host-resident arrays.
+    # _drain_table is inherited: both layouts' drain_np helpers are
+    # pure NumPy over this subclass's host-resident arrays.
 
     def _device_contains(self, fps: np.ndarray) -> np.ndarray:
+        if isinstance(self.table, buckettable.BucketTable):
+            return buckettable.contains_np(
+                np.asarray(self.table.rows), fps, max_probes=self.max_probes
+            )
         return hashtable.contains_np(
             np.asarray(self.table.rows), fps, max_probes=self.max_probes
         )

@@ -1,0 +1,469 @@
+"""Bucketized device-resident dedup set: sort-based insert, tile-aligned rows.
+
+Drop-in alternative to :mod:`ct_mapreduce_tpu.ops.hashtable` (same
+Redis-SADD semantics as the reference's per-certificate ``WasUnknown``
+round trip, /root/reference/storage/knowncertificates.go:38-55), built
+from the primitives the hardware actually favors. Measured on one
+v5e chip at 2^20 lanes (tools/randacc.py, docs/randacc_r04_run.log):
+
+  gather/scatter of 5-word rows:   13.6 / 86.5 ns per lane
+  gather/scatter of 128-word rows: 12.0 / 11.8 ns per lane
+  full 128-bit lexsort + payload:   4.0 ns per lane
+
+i.e. random access costs per-LANE latency, not bandwidth — a 512-byte
+tile-aligned block moves for the price of one word, while a 5-word
+row scatter pays a ~7x tile-misalignment penalty — and sorts are
+nearly free. So:
+
+- The table is an array of BUCKETS: ``rows: uint32[n_buckets, 128]``,
+  each row holding 24 slots x 5 words (4 fingerprint words + meta;
+  words 120..127 spare) — one gather fetches a whole bucket, one
+  scatter commits it, both tile-aligned.
+- Slots fill contiguously (0..fill-1), so occupancy is a scan, not a
+  header word.
+- Within-batch coordination is a SORT, not a scatter election: lanes
+  sort by (bucket, key words, lane). Same-bucket lanes become
+  adjacent, same-key lanes become adjacent-with-deterministic-first
+  (lane order = batch order, matching the reference's sequential
+  first-writer-wins), and every per-bucket quantity (fill, rank,
+  merge window) is a dense segmented scan.
+- Each round, every bucket's first pending lane (the bucket head)
+  composes the merged row — old slots plus up to ``WINDOW`` new keys
+  from its adjacent lanes — and commits it in ONE 128-word scatter.
+- A bucket that is full (all 24 slots occupied, no key match) spills
+  at BUCKET granularity: the lane hops to the next bucket (linear
+  probing over buckets), up to ``max_probes`` hops, then overflows to
+  the exact host lane — the reference's tolerate-and-redirect
+  contract (/root/reference/cmd/ct-fetch/ct-fetch.go:206-225).
+
+The lookup invariant mirrors slot-level open addressing one level up:
+a key lives in the first non-full bucket of its hop chain, so
+``contains`` probes until it hits a key match or a bucket with an
+empty slot. Inserts only hop past a bucket when the round leaves it
+with all 24 slots occupied, which preserves that invariant.
+
+24-way associativity also flattens the load curve: at 75% load the
+probability a bucket is full (Poisson tail) stays small, so inserts
+remain one gather + one scatter where the slot-granular table's probe
+chains lengthen (docs/ladder_r04_run.log's load sweep).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SLOTS = 24  # slots per bucket (24 * 5 = 120 of 128 row words)
+ROW_WORDS = 128
+
+
+def _window_from_env() -> int:
+    raw = os.environ.get("CTMR_BUCKET_WINDOW", "8")
+    try:
+        w = int(raw)
+        if not 1 <= w <= 32:
+            raise ValueError
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"ignoring CTMR_BUCKET_WINDOW={raw!r} (want 1..32); using 8",
+            stacklevel=2)
+        return 8
+    return w
+
+
+#: New keys merged per bucket per round (adjacent-lane look-ahead).
+WINDOW = _window_from_env()
+
+
+class BucketTable(NamedTuple):
+    """Dedup-set state in HBM (donated through insert steps).
+
+    ``rows[b]`` is bucket ``b``: 24 slots x (4 fingerprint words +
+    meta word), filled contiguously; all-zero KEY words mark an empty
+    slot (meta 0 is legal data, exactly as in hashtable.TableState).
+    """
+
+    rows: jax.Array  # uint32[n_buckets, 128]
+    count: jax.Array  # int32[]; occupied slots
+
+    @property
+    def n_buckets(self) -> int:
+        return self.rows.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.rows.shape[0] * SLOTS
+
+    # Positional slot views matching hashtable.TableState's properties,
+    # so the checkpoint codec writes the same (keys, meta) format for
+    # both layouts (slot i = bucket i // SLOTS, position i % SLOTS).
+    # Computed on HOST: a device-side [N, 5] reshape would pad its
+    # minor dim to 128 lanes (25.6x the table's HBM footprint).
+    @property
+    def keys(self):  # uint32[n_buckets * SLOTS, 4]
+        rows = np.asarray(self.rows)
+        return rows[:, : SLOTS * 5].reshape(-1, 5)[:, :4]
+
+    @property
+    def meta(self):  # uint32[n_buckets * SLOTS]
+        rows = np.asarray(self.rows)
+        return rows[:, : SLOTS * 5].reshape(-1, 5)[:, 4]
+
+
+def make_table(capacity: int) -> BucketTable:
+    """Table with at least ``capacity`` slots (n_buckets rounds up to
+    a power of two; real capacity is ``state.capacity``)."""
+    if capacity < 1:
+        raise ValueError(f"capacity must be positive, got {capacity}")
+    n_buckets = 1 << max(0, (capacity + SLOTS - 1) // SLOTS - 1).bit_length()
+    return BucketTable(
+        rows=jnp.zeros((n_buckets, ROW_WORDS), dtype=jnp.uint32),
+        count=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def _desentinel(keys: jax.Array) -> jax.Array:
+    """Remap the (2^-128-unlikely) all-zero fingerprint, mirroring
+    hashtable._desentinel so both tables share key semantics."""
+    is_zero = jnp.all(keys == 0, axis=-1, keepdims=True)
+    bump = jnp.concatenate(
+        [jnp.zeros(keys.shape[:-1] + (3,), jnp.uint32),
+         jnp.ones(keys.shape[:-1] + (1,), jnp.uint32)], axis=-1)
+    return jnp.where(is_zero, bump, keys)
+
+
+def _home_bucket(keys: jax.Array, n_buckets: int) -> jax.Array:
+    h = keys[:, 0] ^ (keys[:, 1] * np.uint32(0x9E3779B9))
+    # Independent of the in-bucket layout; distinct from hashtable's
+    # slot hash only through the modulus.
+    return (h & np.uint32(n_buckets - 1)).astype(jnp.int32)
+
+
+def _shift_up(a: jax.Array, j: int, fill) -> jax.Array:
+    """a[i + j] with ``fill`` past the end (j >= 0 static)."""
+    n = a.shape[0]
+    if j == 0:
+        return a
+    if j >= n:
+        return jnp.full_like(a, fill)
+    pad = jnp.full((j,) + a.shape[1:], fill, dtype=a.dtype)
+    return jnp.concatenate([a[j:], pad], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_probes",), donate_argnums=(0,))
+def insert(
+    state: BucketTable,
+    keys: jax.Array,
+    meta: jax.Array,
+    valid: jax.Array,
+    max_probes: int = 32,
+):
+    """Batch insert-if-absent. Same contract as ``hashtable.insert``:
+
+    Returns ``(new_state, was_unknown bool[B], overflowed bool[B])``
+    with ``was_unknown`` true for the first lane (in batch order) of
+    each genuinely-new key, false for re-inserts and within-batch
+    duplicates; ``overflowed`` lanes must take the exact host lane.
+    ``max_probes`` bounds bucket HOPS (each hop skips a full bucket =
+    24 slots, so chains are far shorter than slot-granular probing).
+    """
+    rows = state.rows
+    nb = rows.shape[0]
+    b = keys.shape[0]
+    keys = _desentinel(keys.astype(jnp.uint32))
+    h0 = _home_bucket(keys, nb)
+    lane = jnp.arange(b, dtype=jnp.int32)
+    sentinel = jnp.int32(nb)  # resolved lanes sort past every bucket
+    idx = lane  # alias: position index in sorted order
+
+    # Per-lane flags packed into one sort payload word:
+    # bit0 known (seen before), bit1 inserted, bits 8.. hop count.
+    K_KNOWN = jnp.uint32(1)
+    K_INS = jnp.uint32(2)
+    HOP_1 = jnp.uint32(256)
+
+    # Round budget: each round commits >= 1 new key per active bucket
+    # (the bucket head is always in its own window), so only window
+    # retries and hops consume rounds. Hops are bounded by max_probes;
+    # a few extra rounds absorb window-limited retries on skewed
+    # batches before the overflow contract hands lanes to the host.
+    max_rounds = max_probes + 16
+
+    def cond(carry):
+        rounds = carry[0]
+        h = carry[2]
+        return (rounds < max_rounds) & jnp.any(h < sentinel)
+
+    def round_body(carry):
+        rounds, rows, h, k0, k1, k2, k3, mt, ln, flags = carry
+
+        # Sort pending lanes by (bucket, key, lane): same-bucket lanes
+        # adjacent, same-key lanes adjacent with batch-order-first;
+        # resolved lanes (h == sentinel) sink to the end.
+        h, k0, k1, k2, k3, ln, mt, flags = jax.lax.sort(
+            (h, k0, k1, k2, k3, ln, mt, flags), num_keys=6)
+        pend = h < sentinel
+        kw = (k0, k1, k2, k3)
+
+        # One tile-aligned gather per lane: the whole bucket.
+        #
+        # LAYOUT RULE for everything below: intermediates stay either
+        # 1-D [B] or full-width [B, 128]. Any [B, small] array (a
+        # stack/concat of columns, a [B, SLOTS, 5] reshape) pads its
+        # minor dim to 128 lanes on TPU — measured 62 GB of padding at
+        # 2^20 lanes for the stacked formulation of this very loop.
+        row = rows[jnp.minimum(h, nb - 1)]  # [B, 128]
+
+        # Slot scan via per-column [B] slices of the gathered row.
+        fill = jnp.zeros((b,), jnp.int32)
+        in_row = jnp.zeros((b,), bool)
+        for s in range(SLOTS):
+            w = [row[:, s * 5 + i] for i in range(4)]
+            occ_s = (w[0] | w[1] | w[2] | w[3]) != 0
+            fill = fill + occ_s.astype(jnp.int32)
+            in_row = in_row | (
+                (w[0] == k0) & (w[1] == k1) & (w[2] == k2) & (w[3] == k3))
+        in_row = pend & in_row
+
+        # Segment structure over the sorted order (dense scans only).
+        def prev(a, fillv):
+            return jnp.concatenate(
+                [jnp.full((1,), fillv, a.dtype), a[:-1]])
+
+        bucket_head = pend & (h != prev(h, -1))
+        key_diff = (
+            (k0 != prev(k0, 0)) | (k1 != prev(k1, 0))
+            | (k2 != prev(k2, 0)) | (k3 != prev(k3, 0)))
+        key_head = pend & (bucket_head | key_diff)
+        dup_lane = pend & ~key_head  # same key as an earlier lane
+        new_head = key_head & ~in_row
+
+        # Rank among new heads within my bucket segment (cumsum with a
+        # cummax-propagated segment base — c is nondecreasing, so the
+        # latest bucket head always wins the max).
+        x = new_head.astype(jnp.int32)
+        c = jnp.cumsum(x)
+        base = jax.lax.cummax(jnp.where(bucket_head, c - x, -1))
+        r = c - x - base  # 0-based new-key rank in segment
+
+        # Head computes how many new keys land in its WINDOW-lane
+        # look-ahead, then broadcasts (start index, count) down the
+        # segment through one monotone cummax.
+        same_seg_w = jnp.zeros((b,), jnp.int32)
+        for j in range(WINDOW):
+            nh_j = _shift_up(new_head, j, False)
+            h_j = _shift_up(h, j, sentinel)
+            same_seg_w = same_seg_w + (nh_j & (h_j == h)).astype(jnp.int32)
+        enc = jnp.where(bucket_head, idx * 64 + jnp.minimum(same_seg_w, 63),
+                        -1)
+        cm = jax.lax.cummax(enc)
+        bs = cm // 64  # my bucket head's sorted position
+        w_seg = cm % 64  # new keys in the head's window
+        pos = idx - bs  # my offset inside the segment
+
+        # Merge decision, identical arithmetic for the head composing
+        # the row and for each candidate judging itself: in-window new
+        # heads hold consecutive ranks 0..w_seg-1, so `fill + r` is
+        # exactly the slot a merged key occupies.
+        space = SLOTS - fill
+        merged = new_head & (pos < WINDOW) & (r < space)
+        full_after = w_seg >= space  # bucket leaves this round full
+
+        # Compose merged rows at bucket heads as ONE fused elementwise
+        # expression over the [B, 128] row: candidate j of a head
+        # writes its 5 words at columns tgt_j*5 .. tgt_j*5+4. Every
+        # [B]-vector broadcasts along the lane axis inside the fusion
+        # (no [B, 1] materialization — see the layout rule above), and
+        # candidates hold distinct slots, so the wheres commute.
+        col = jnp.arange(ROW_WORDS, dtype=jnp.int32)[None, :]  # [1, 128]
+        outrow = row
+        for j in range(WINDOW):
+            m_j = _shift_up(merged, j, False)
+            bs_j = _shift_up(bs, j, -1)
+            ok_j = m_j & (bs_j == idx)  # candidate belongs to MY segment
+            r_j = _shift_up(r, j, 0)
+            tgt = fill + r_j
+            off = col - (tgt * 5)[:, None]  # [B, 128]
+            val = jnp.where(
+                off == 0, _shift_up(k0, j, jnp.uint32(0))[:, None],
+                jnp.where(
+                    off == 1, _shift_up(k1, j, jnp.uint32(0))[:, None],
+                    jnp.where(
+                        off == 2, _shift_up(k2, j, jnp.uint32(0))[:, None],
+                        jnp.where(
+                            off == 3,
+                            _shift_up(k3, j, jnp.uint32(0))[:, None],
+                            _shift_up(mt, j, jnp.uint32(0))[:, None]))))
+            sel = ok_j[:, None] & (off >= 0) & (off < 5)
+            outrow = jnp.where(sel, val, outrow)
+
+        # One tile-aligned scatter per active bucket (heads hold
+        # unique, sorted bucket ids — no duplicate indices).
+        write = bucket_head & (w_seg > 0) & (space > 0)
+        wslot = jnp.where(write, h, sentinel)
+        rows = rows.at[wslot].set(outrow, mode="drop")
+
+        # Resolve lanes. Duplicate lanes resolve as known even when
+        # their key head is still pending: the head (or, on overflow,
+        # the exact host lane) accounts for the single fresh insert.
+        flags = jnp.where(pend & (in_row | dup_lane), flags | K_KNOWN, flags)
+        flags = jnp.where(merged, flags | K_INS, flags)
+        resolved = in_row | dup_lane | merged
+        still = pend & ~resolved
+        hop = still & full_after
+        flags = jnp.where(hop, flags + HOP_1, flags)
+        hops = (flags >> 8).astype(jnp.int32)
+        ovf_now = hop & (hops >= max_probes)
+        # Overflowed lanes resolve (host lane takes them); hopping
+        # lanes advance one bucket; window-limited lanes retry.
+        h = jnp.where(still & ~ovf_now,
+                      jnp.where(hop, (h + 1) & (nb - 1), h), sentinel)
+        # Mark terminal overflow in a flag bit (bit2).
+        flags = jnp.where(ovf_now, flags | jnp.uint32(4), flags)
+        return (rounds + 1, rows, h, k0, k1, k2, k3, mt, ln, flags)
+
+    h_init = jnp.where(valid, h0, sentinel)
+    flags0 = jnp.zeros((b,), jnp.uint32)
+    carry = (jnp.int32(0), rows, h_init,
+             keys[:, 0], keys[:, 1], keys[:, 2], keys[:, 3],
+             meta.astype(jnp.uint32), lane, flags0)
+    (_, rows, h_fin, _, _, _, _, _, ln_fin, flags_fin) = jax.lax.while_loop(
+        cond, round_body, carry)
+
+    # Unsort the per-lane outcome in ONE scalar scatter: lanes that
+    # left the loop still pending (round budget) also overflow.
+    res_sorted = (
+        flags_fin
+        | jnp.where(h_fin < sentinel, jnp.uint32(4), jnp.uint32(0)))
+    packed = jnp.zeros((b,), jnp.uint32).at[ln_fin].set(
+        res_sorted, mode="drop")
+    was_unknown = (packed & 2) != 0
+    overflowed = (packed & 4) != 0
+    new_count = state.count + jnp.sum(was_unknown, dtype=jnp.int32)
+    return BucketTable(rows, new_count), was_unknown, overflowed
+
+
+@functools.partial(jax.jit, static_argnames=("max_probes",))
+def contains(state: BucketTable, keys: jax.Array,
+             max_probes: int = 32) -> jax.Array:
+    """Batch membership query: bool[B]. One bucket gather resolves a
+    lane unless the bucket is full-without-match (then it hops, like
+    the insert's bucket-granular open addressing)."""
+    rows = state.rows
+    nb = rows.shape[0]
+    b = keys.shape[0]
+    keys = _desentinel(keys.astype(jnp.uint32))
+    h0 = _home_bucket(keys, nb)
+
+    def cond(carry):
+        hops, _h, open_, _found = carry[0], carry[1], carry[2], carry[3]
+        return (hops < max_probes) & jnp.any(open_)
+
+    def round_body(carry):
+        hops, h, open_, found = carry
+        row = rows[h]  # [B, 128]
+        # Per-column [B] slices, not a [B, SLOTS, 5] reshape — small
+        # minor dims pad to 128 lanes on TPU (layout rule in insert).
+        match = jnp.zeros((b,), bool)
+        has_empty = jnp.zeros((b,), bool)
+        for s in range(SLOTS):
+            w = [row[:, s * 5 + i] for i in range(4)]
+            match = match | (
+                (w[0] == keys[:, 0]) & (w[1] == keys[:, 1])
+                & (w[2] == keys[:, 2]) & (w[3] == keys[:, 3]))
+            has_empty = has_empty | ((w[0] | w[1] | w[2] | w[3]) == 0)
+        found = found | (open_ & match)
+        open_ = open_ & ~match & ~has_empty
+        h = jnp.where(open_, (h + 1) & (nb - 1), h)
+        return hops + 1, h, open_, found
+
+    _, _, _, found = jax.lax.while_loop(
+        cond, round_body,
+        (jnp.int32(0), h0, jnp.ones((b,), bool), jnp.zeros((b,), bool)))
+    return found
+
+
+def contains_np(rows_np: np.ndarray, keys: np.ndarray,
+                max_probes: int = 32) -> np.ndarray:
+    """NumPy mirror of :func:`contains` for host-only snapshot reads
+    (storage-statistics must not touch the device)."""
+    nb = rows_np.shape[0]
+    keys = keys.astype(np.uint32, copy=True).reshape(-1, 4)
+    zero = ~keys.any(axis=-1)
+    keys[zero, 3] = 1  # _desentinel
+    h = ((keys[:, 0] ^ (keys[:, 1] * np.uint32(0x9E3779B9)))
+         & np.uint32(nb - 1)).astype(np.int64)
+    out = np.zeros((keys.shape[0],), bool)
+    open_ = np.ones((keys.shape[0],), bool)
+    slots = rows_np[:, : SLOTS * 5].reshape(nb, SLOTS, 5)
+    for _ in range(max_probes):
+        if not open_.any():
+            break
+        rows = slots[h[open_]]  # [n, SLOTS, 5]
+        match = (rows[:, :, :4] == keys[open_][:, None, :]).all(-1).any(-1)
+        has_empty = (~rows[:, :, :4].any(-1)).any(-1)
+        sub = np.where(open_)[0]
+        out[sub[match]] = True
+        still = ~match & ~has_empty
+        open_[sub[~still]] = False
+        h[sub[still]] = (h[sub[still]] + 1) & (nb - 1)
+    return out
+
+
+def drain_np(state: BucketTable) -> tuple[np.ndarray, np.ndarray]:
+    """Pull (keys uint32[N, 4], meta uint32[N]) of occupied slots."""
+    rows = np.asarray(state.rows)
+    slots = rows[:, : SLOTS * 5].reshape(-1, 5)
+    occ = slots[:, :4].any(axis=-1)
+    return slots[occ, :4], slots[occ, 4]
+
+
+def bulk_insert_np(rows_np: np.ndarray, keys: np.ndarray,
+                   meta: np.ndarray, max_probes: int = 32) -> int:
+    """Host-side rebuild: insert unique ``keys`` into ``rows_np`` in
+    place (restore / grow path). Returns the number of keys that
+    could not be placed within ``max_probes`` hops.
+
+    Vectorized by rounds: bucket fills via bincount, per-bucket ranks
+    via argsort order, spillover hops to the next bucket.
+    """
+    nb = rows_np.shape[0]
+    keys = keys.astype(np.uint32).reshape(-1, 4)
+    meta = meta.astype(np.uint32).reshape(-1)
+    zero = ~keys.any(axis=-1)
+    if zero.any():
+        keys = keys.copy()
+        keys[zero, 3] = 1
+    h = ((keys[:, 0] ^ (keys[:, 1] * np.uint32(0x9E3779B9)))
+         & np.uint32(nb - 1)).astype(np.int64)
+    slots = rows_np[:, : SLOTS * 5].reshape(nb, SLOTS, 5)
+    fill = (slots[:, :, :4].any(axis=-1)).sum(axis=-1).astype(np.int64)
+    alive = np.ones(keys.shape[0], bool)
+    for _ in range(max_probes):
+        if not alive.any():
+            break
+        sub = np.where(alive)[0]
+        order = sub[np.argsort(h[sub], kind="stable")]
+        hs = h[order]
+        seg_start = np.r_[True, hs[1:] != hs[:-1]]
+        seg_idx = np.cumsum(seg_start) - 1
+        first = np.where(seg_start)[0]
+        rank = np.arange(len(order)) - first[seg_idx]
+        slot = fill[hs] + rank
+        ok = slot < SLOTS
+        tgt = order[ok]
+        slots[hs[ok], slot[ok], :4] = keys[tgt]
+        slots[hs[ok], slot[ok], 4] = meta[tgt]
+        np.add.at(fill, hs[ok], 0)  # fills recomputed below per bucket
+        placed_per_bucket = np.bincount(hs[ok], minlength=nb)
+        fill += placed_per_bucket
+        alive[tgt] = False
+        h[order[~ok]] = (h[order[~ok]] + 1) & (nb - 1)
+    return int(alive.sum())

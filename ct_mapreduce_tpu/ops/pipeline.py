@@ -27,7 +27,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from ct_mapreduce_tpu.core import packing
-from ct_mapreduce_tpu.ops import der_kernel, hashtable, sha256
+from ct_mapreduce_tpu.ops import buckettable, der_kernel, hashtable, sha256
+
+
+def table_insert(table, keys, meta, valid, max_probes: int = 32):
+    """Insert-if-absent on either dedup-table layout.
+
+    Dispatches on the state type at trace time (each layout is its own
+    pytree, so jit caches separate programs): ``BucketTable`` takes the
+    sort-based bucket path (ops/buckettable.py — the measured-fast
+    layout), ``hashtable.TableState`` the slot-granular probe path."""
+    if isinstance(table, buckettable.BucketTable):
+        return buckettable.insert(table, keys, meta, valid,
+                                  max_probes=max_probes)
+    return hashtable.insert(table, keys, meta, valid, max_probes=max_probes)
 
 
 class StepOut(NamedTuple):
@@ -255,7 +268,7 @@ def ingest_core(
     )
     parsed = lanes.parsed
 
-    table, was_unknown, overflowed = hashtable.insert(
+    table, was_unknown, overflowed = table_insert(
         table, lanes.fps, lanes.meta, lanes.insertable, max_probes=max_probes
     )
 

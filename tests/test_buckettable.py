@@ -1,0 +1,261 @@
+"""Bucketized dedup table: Redis SADD semantics on device, sort-based.
+
+Parity oracle is a plain Python set — the same oracle the slot-granular
+table uses (tests/test_hashtable.py), because both implement the
+reference's WasUnknown contract
+(/root/reference/storage/knowncertificates.go:38-55). Extra coverage
+targets the bucket layout's own edges: full buckets hopping at bucket
+granularity, window-limited merges needing extra rounds, contiguous
+slot fill, and the cross-layout checkpoint positions.
+"""
+
+import numpy as np
+import pytest
+
+from ct_mapreduce_tpu.ops import buckettable as bt
+
+
+def rand_keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
+
+
+def as_tuple(k):
+    return tuple(int(x) for x in k)
+
+
+def test_make_table_rounds_up_to_buckets():
+    state = bt.make_table(1)
+    assert state.n_buckets == 1 and state.capacity == bt.SLOTS
+    state = bt.make_table(bt.SLOTS + 1)
+    assert state.n_buckets == 2
+    state = bt.make_table(1 << 10)
+    assert state.capacity >= 1 << 10
+    assert state.n_buckets & (state.n_buckets - 1) == 0
+
+
+def test_insert_then_reinsert():
+    state = bt.make_table(256)
+    keys = rand_keys(16)
+    valid = np.ones(16, bool)
+    meta = np.arange(16, dtype=np.uint32)
+    state, unknown, overflow = bt.insert(state, keys, meta, valid)
+    assert np.asarray(unknown).all()
+    assert not np.asarray(overflow).any()
+    assert int(state.count) == 16
+    state, unknown2, overflow2 = bt.insert(state, keys, meta, valid)
+    assert not np.asarray(unknown2).any()
+    assert not np.asarray(overflow2).any()
+    assert int(state.count) == 16
+
+
+def test_within_batch_duplicates_first_lane_wins():
+    state = bt.make_table(256)
+    base = rand_keys(4, seed=1)
+    keys = np.concatenate([base, base, base[:2]])
+    valid = np.ones(len(keys), bool)
+    meta = np.zeros(len(keys), np.uint32)
+    state, unknown, _ = bt.insert(state, keys, meta, valid)
+    unknown = np.asarray(unknown)
+    assert unknown.sum() == 4
+    # The FIRST lane in batch order of each distinct key reports
+    # unknown (lane is the sort tiebreak — reference semantics are
+    # sequential first-writer-wins).
+    first = {}
+    for i, k in enumerate(keys):
+        first.setdefault(as_tuple(k), i)
+    for i, k in enumerate(keys):
+        assert unknown[i] == (first[as_tuple(k)] == i)
+    assert int(state.count) == 4
+
+
+def test_invalid_lanes_ignored():
+    state = bt.make_table(64)
+    keys = rand_keys(8, seed=2)
+    valid = np.array([True, False] * 4)
+    meta = np.zeros(8, np.uint32)
+    state, unknown, _ = bt.insert(state, keys, meta, valid)
+    unknown = np.asarray(unknown)
+    assert unknown[valid].all()
+    assert not unknown[~valid].any()
+    assert int(state.count) == 4
+
+
+def test_invalid_then_valid_same_key():
+    state = bt.make_table(64)
+    k = rand_keys(1, seed=3)
+    keys = np.concatenate([k, k])
+    valid = np.array([False, True])
+    meta = np.zeros(2, np.uint32)
+    state, unknown, _ = bt.insert(state, keys, meta, valid)
+    assert list(np.asarray(unknown)) == [False, True]
+    assert int(state.count) == 1
+
+
+def test_parity_vs_python_set_across_batches():
+    state = bt.make_table(1 << 9)  # 32 buckets — real collisions
+    oracle = set()
+    rng = np.random.default_rng(7)
+    pool = rand_keys(600, seed=8)
+    for r in range(6):
+        pick = rng.integers(0, len(pool), size=128)
+        keys = pool[pick]
+        meta = np.zeros(len(keys), np.uint32)
+        valid = np.ones(len(keys), bool)
+        state, unknown, overflow = bt.insert(state, keys, meta, valid)
+        unknown, overflow = np.asarray(unknown), np.asarray(overflow)
+        batch_first = set()
+        for i, k in enumerate(keys):
+            t = as_tuple(k)
+            if overflow[i]:
+                continue
+            expect = t not in oracle and t not in batch_first
+            assert bool(unknown[i]) == expect, (r, i)
+            batch_first.add(t)
+        oracle.update(
+            as_tuple(k) for i, k in enumerate(keys) if not overflow[i]
+        )
+        assert not overflow.any()  # plenty of buckets for 600 keys
+    assert int(state.count) == len(oracle)
+
+
+def test_full_bucket_hops_then_overflows():
+    # Single bucket: 24 slots. All keys hash to bucket 0 (nb=1), so
+    # keys 25.. must hop — and with nowhere to hop (nb=1, hop wraps to
+    # the same full bucket), they overflow to the host lane.
+    state = bt.make_table(bt.SLOTS)
+    keys = rand_keys(40, seed=9)
+    meta = np.arange(40, dtype=np.uint32)
+    valid = np.ones(40, bool)
+    state, unknown, overflow = bt.insert(
+        state, keys, meta, valid, max_probes=4)
+    unknown, overflow = np.asarray(unknown), np.asarray(overflow)
+    assert unknown.sum() == bt.SLOTS
+    assert overflow.sum() == 40 - bt.SLOTS
+    assert not (unknown & overflow).any()
+    assert int(state.count) == bt.SLOTS
+    # The table still answers membership exactly for what it holds.
+    got = np.asarray(bt.contains(state, keys))
+    assert (got == unknown).all()
+
+
+def test_hop_chain_spills_to_next_bucket():
+    # Two buckets; over-fill bucket h of each key's home so spill keys
+    # land in the neighbor and contains() follows the hop chain.
+    state = bt.make_table(2 * bt.SLOTS)
+    keys = rand_keys(2 * bt.SLOTS + 10, seed=11)
+    meta = np.zeros(len(keys), np.uint32)
+    valid = np.ones(len(keys), bool)
+    state, unknown, overflow = bt.insert(state, keys, meta, valid)
+    unknown, overflow = np.asarray(unknown), np.asarray(overflow)
+    # Everything fits (48 slots, 58 keys → 48 inserted, 10 overflow)
+    assert unknown.sum() == 2 * bt.SLOTS
+    assert overflow.sum() == 10
+    got = np.asarray(bt.contains(state, keys))
+    assert (got == unknown).all()
+    got_np = bt.contains_np(np.asarray(state.rows), keys)
+    assert (got_np == unknown).all()
+
+
+def test_window_limited_merge_retries_resolve():
+    # More distinct new keys in one bucket in one batch than WINDOW:
+    # later key-heads must retry (same bucket, next round) and still
+    # land, with contiguous fill.
+    state = bt.make_table(bt.SLOTS)  # nb=1: every key same bucket
+    n = bt.SLOTS  # 24 distinct > WINDOW (8)
+    keys = rand_keys(n, seed=12)
+    meta = np.arange(n, dtype=np.uint32)
+    valid = np.ones(n, bool)
+    state, unknown, overflow = bt.insert(state, keys, meta, valid)
+    assert np.asarray(unknown).all()
+    assert not np.asarray(overflow).any()
+    assert int(state.count) == n
+    rows = np.asarray(state.rows)
+    slots = rows[:, : bt.SLOTS * 5].reshape(-1, bt.SLOTS, 5)
+    occ = slots[0, :, :4].any(axis=-1)
+    assert occ.all()  # contiguous fill to exactly SLOTS
+
+
+def test_zero_key_desentinel():
+    state = bt.make_table(64)
+    keys = np.zeros((2, 4), np.uint32)
+    meta = np.zeros(2, np.uint32)
+    valid = np.ones(2, bool)
+    state, unknown, _ = bt.insert(state, keys, meta, valid)
+    assert list(np.asarray(unknown)) == [True, False]
+    assert int(state.count) == 1
+    assert np.asarray(bt.contains(state, np.zeros((1, 4), np.uint32)))[0]
+
+
+def test_meta_round_trips_through_drain():
+    state = bt.make_table(512)
+    keys = rand_keys(50, seed=13)
+    meta = np.arange(50, dtype=np.uint32) + 1000
+    valid = np.ones(50, bool)
+    state, _, _ = bt.insert(state, keys, meta, valid)
+    dkeys, dmeta = bt.drain_np(state)
+    assert dkeys.shape == (50, 4)
+    got = {as_tuple(k): int(m) for k, m in zip(dkeys, dmeta)}
+    want = {as_tuple(k): int(m) for k, m in zip(keys, meta)}
+    assert got == want
+
+
+def test_bulk_insert_np_matches_device_contains():
+    state = bt.make_table(1 << 9)
+    rows = np.asarray(state.rows).copy()
+    keys = rand_keys(200, seed=14)
+    meta = np.arange(200, dtype=np.uint32)
+    left = bt.bulk_insert_np(rows, keys, meta)
+    assert left == 0
+    state = bt.BucketTable(rows=rows, count=np.int32(200))
+    assert bt.contains_np(rows, keys).all()
+    assert not bt.contains_np(rows, rand_keys(64, seed=15)).any()
+    # Device insert of the same keys sees them as known.
+    import jax.numpy as jnp
+
+    dstate = bt.BucketTable(rows=jnp.asarray(rows),
+                            count=jnp.asarray(np.int32(200)))
+    dstate, unknown, _ = bt.insert(
+        dstate, keys[:64], meta[:64], np.ones(64, bool))
+    assert not np.asarray(unknown).any()
+
+
+def test_checkpoint_slot_positions_reconstruct():
+    # keys/meta positional views → rebuild rows → identical behavior
+    # (the aggregator checkpoint codec round-trip, layout="bucket").
+    state = bt.make_table(256)
+    keys = rand_keys(60, seed=16)
+    meta = np.arange(60, dtype=np.uint32)
+    state, _, _ = bt.insert(state, keys, meta, np.ones(60, bool))
+    k = np.asarray(state.keys)
+    m = np.asarray(state.meta)
+    nb = state.n_buckets
+    rows = np.zeros((nb, bt.ROW_WORDS), np.uint32)
+    fused = np.concatenate([k, m[:, None]], axis=1)
+    rows[:, : bt.SLOTS * 5] = fused.reshape(nb, -1)
+    assert (rows == np.asarray(state.rows)).all()
+
+
+def test_pipeline_dispatch_picks_bucket_insert():
+    from ct_mapreduce_tpu.ops import pipeline
+
+    state = bt.make_table(256)
+    keys = rand_keys(8, seed=17)
+    meta = np.zeros(8, np.uint32)
+    state2, unknown, _ = pipeline.table_insert(
+        state, keys, meta, np.ones(8, bool))
+    assert isinstance(state2, bt.BucketTable)
+    assert np.asarray(unknown).all()
+
+
+def test_skewed_flood_single_key():
+    # A whole batch of one repeated key: one True, rest False, one slot.
+    state = bt.make_table(64)
+    keys = np.tile(rand_keys(1, seed=18), (256, 1))
+    meta = np.zeros(256, np.uint32)
+    state, unknown, overflow = bt.insert(
+        state, keys, meta, np.ones(256, bool))
+    unknown = np.asarray(unknown)
+    assert unknown.sum() == 1 and unknown[0]
+    assert not np.asarray(overflow).any()
+    assert int(state.count) == 1

@@ -45,8 +45,15 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     from ct_mapreduce_tpu.core import packing
-    from ct_mapreduce_tpu.ops import der_kernel, hashtable, pipeline
+    from ct_mapreduce_tpu.ops import buckettable, der_kernel, hashtable, pipeline
     from ct_mapreduce_tpu.utils import syncerts
+
+    # The `full` stage builds whichever table layout the aggregator
+    # would (CTMR_TABLE, default bucket) — ingest_core dispatches.
+    if os.environ.get("CTMR_TABLE", "bucket").strip().lower() == "open":
+        mk_table = hashtable.make_table
+    else:
+        mk_table = buckettable.make_table
 
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
     only = set(sys.argv[2:])
@@ -163,7 +170,7 @@ def main() -> None:
             return jax.lax.fori_loop(0, n_sweeps, body, (table, acc))
 
         fetch = jax.jit(lambda a: a + jnp.uint32(0))
-        table = hashtable.make_table(cap)
+        table = mk_table(cap)
         acc = jax.device_put(np.uint32(0))
         t0 = time.perf_counter()
         table, acc = mega(table, acc, np.int32(1), datas, lens,
