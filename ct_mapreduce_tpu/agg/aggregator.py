@@ -57,20 +57,9 @@ from ct_mapreduce_tpu.ops import buckettable, der_kernel, hashtable, pipeline
 from ct_mapreduce_tpu.telemetry.metrics import incr_counter, set_gauge
 
 
-def _table_layout() -> str:
-    """Dedup-table layout: ``bucket`` (default — the sort-based
-    24-slot-bucket table the round-4 hardware measurements favor by
-    ~an order of magnitude on the insert, ops/buckettable.py) or
-    ``open`` (slot-granular open addressing, ops/hashtable.py)."""
-    layout = os.environ.get("CTMR_TABLE", "bucket").strip().lower()
-    if layout not in ("bucket", "open"):
-        import warnings
-
-        warnings.warn(
-            f"ignoring CTMR_TABLE={layout!r} (want bucket|open); "
-            "using bucket", stacklevel=2)
-        return "bucket"
-    return layout
+# Layout selection lives beside the insert dispatch (CTMR_TABLE,
+# default bucket); re-exported here for the aggregator's callers.
+_table_layout = pipeline.table_layout
 
 
 class IssuerRegistry:

@@ -40,14 +40,22 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ct_mapreduce_tpu.core import packing
-from ct_mapreduce_tpu.ops import hashtable, pipeline
+from ct_mapreduce_tpu.ops import buckettable, hashtable, pipeline
 
 AXIS = "shard"
 
 
-def mesh_capacity(n_shards: int, capacity: int) -> int:
+def mesh_capacity(n_shards: int, capacity: int,
+                  layout: str | None = None) -> int:
     """Smallest capacity ≥ ``capacity`` that divides over ``n_shards``
-    with a power-of-two per-shard slice (the probe mask requirement)."""
+    with a power-of-two per-shard unit (slots for the open layout,
+    buckets for the bucket layout — the hash mask requirement)."""
+    if (layout or pipeline.table_layout()) == "bucket":
+        per_slots = max(1, -(-capacity // n_shards))
+        nb_loc = 1 << max(
+            0, (per_slots + buckettable.SLOTS - 1) // buckettable.SLOTS - 1
+        ).bit_length()
+        return n_shards * nb_loc * buckettable.SLOTS
     per = max(1, -(-capacity // n_shards))  # ceil
     return n_shards * (1 << (per - 1).bit_length())
 
@@ -85,6 +93,48 @@ def _shard_of(keys: jax.Array, n_shards: int) -> jax.Array:
     hot-key events (pinned by test_sharded_zipfian_issuer_skew)."""
     h = keys[:, 2] ^ (keys[:, 3] * np.uint32(0x85EBCA6B))
     return (h % np.uint32(n_shards)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("n_shards", "max_probes"))
+def _contains_global_bucket(
+    table_rows: jax.Array, keys: jax.Array,
+    n_shards: int, max_probes: int,
+) -> jax.Array:
+    """Membership over the globally-viewed bucket-sharded table:
+    shard-of-key addressing + the local bucket-hop probe of
+    ``buckettable.contains``, as one gather-only jit."""
+    nb_total = table_rows.shape[0]
+    nb_loc = nb_total // n_shards
+    b = keys.shape[0]
+    keys = buckettable._desentinel(keys.astype(jnp.uint32))
+    dest = _shard_of(keys, n_shards)
+    h0 = buckettable._home_bucket(keys, nb_loc)
+    S = buckettable.SLOTS
+
+    def cond(carry):
+        hops, _h, open_, _found = carry
+        return (hops < max_probes) & jnp.any(open_)
+
+    def round_body(carry):
+        hops, h, open_, found = carry
+        row = table_rows[dest * nb_loc + h]  # [B, 128]
+        match = jnp.zeros((b,), bool)
+        has_empty = jnp.zeros((b,), bool)
+        for s in range(S):
+            w = [row[:, s * 5 + i] for i in range(4)]
+            match = match | (
+                (w[0] == keys[:, 0]) & (w[1] == keys[:, 1])
+                & (w[2] == keys[:, 2]) & (w[3] == keys[:, 3]))
+            has_empty = has_empty | ((w[0] | w[1] | w[2] | w[3]) == 0)
+        found = found | (open_ & match)
+        open_ = open_ & ~match & ~has_empty
+        h = jnp.where(open_, (h + 1) & (nb_loc - 1), h)
+        return hops + 1, h, open_, found
+
+    _, _, _, found = jax.lax.while_loop(
+        cond, round_body,
+        (jnp.int32(0), h0, jnp.ones((b,), bool), jnp.zeros((b,), bool)))
+    return found
 
 
 @functools.partial(jax.jit, static_argnames=("n_shards", "max_probes"))
@@ -183,7 +233,7 @@ def _local_step(
     data, length, issuer_idx, valid,
     now_hour, base_hour, cn_prefixes, cn_prefix_lens,
     *, n_shards: int, cap: int, num_issuers: int, max_probes: int,
-    axis: str = AXIS,
+    bucket: bool = False, axis: str = AXIS,
 ):
     """Per-device body, run under shard_map over the 1-D mesh."""
     # --- stage 1: local parse / filter / fingerprint (pure DP) ----------
@@ -212,8 +262,11 @@ def _local_step(
     rk = recv.reshape(n_shards * cap, 5)
     rvalid = recv_valid.reshape(n_shards * cap)
     rkeys, rmeta = rk[:, :4], rk[:, 4]
-    state = hashtable.TableState(table_rows, table_count)
-    state, r_unknown, r_overflow = hashtable.insert(
+    if bucket:
+        state = buckettable.BucketTable(table_rows, table_count)
+    else:
+        state = hashtable.TableState(table_rows, table_count)
+    state, r_unknown, r_overflow = pipeline.table_insert(
         state, rkeys, rmeta, rvalid, max_probes=max_probes
     )
 
@@ -292,25 +345,43 @@ class ShardedDedup:
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_shards = mesh.devices.size
+        self.layout = pipeline.table_layout()
         if capacity % self.n_shards:
             raise ValueError("capacity must divide evenly across the mesh")
-        # The triangular-probe mask operates on each LOCAL shard inside
-        # shard_map, so per-shard size is what must be a power of two.
         per_shard = capacity // self.n_shards
-        if per_shard & (per_shard - 1):
-            raise ValueError("per-shard capacity must be a power of two")
+        row_sharded = NamedSharding(mesh, P(self.axis))
+        if self.layout == "bucket":
+            # The home-bucket mask operates on each LOCAL shard's
+            # bucket array inside shard_map, so per-shard BUCKET count
+            # must be a power of two — rounded UP here (capacity is a
+            # floor, mirroring buckettable.make_table; the realized
+            # slot count is ``self.capacity`` after this block).
+            nb_loc = 1 << max(
+                0, (per_shard + buckettable.SLOTS - 1) // buckettable.SLOTS
+                - 1).bit_length()
+            capacity = self.n_shards * nb_loc * buckettable.SLOTS
+            # Bucket rows, row-sharded: shard i holds buckets
+            # [i*nb_loc, (i+1)*nb_loc).
+            self.rows = jax.device_put(
+                jnp.zeros((self.n_shards * nb_loc, buckettable.ROW_WORDS),
+                          jnp.uint32), row_sharded
+            )
+        else:
+            # The triangular-probe mask operates on each LOCAL shard
+            # inside shard_map, so per-shard SLOT count must be a
+            # power of two.
+            if per_shard & (per_shard - 1):
+                raise ValueError("per-shard capacity must be a power of two")
+            # Fused table rows (4 fp words + meta), row-sharded over
+            # the mesh — same layout as the single-chip TableState.
+            self.rows = jax.device_put(
+                jnp.zeros((capacity, 5), jnp.uint32), row_sharded
+            )
         self.capacity = capacity
         self.base_hour = base_hour
         self.num_issuers = num_issuers
         self.max_probes = max_probes
         self.dispatch_factor = dispatch_factor
-
-        row_sharded = NamedSharding(mesh, P(self.axis))
-        # Fused table rows (4 fp words + meta), row-sharded over the
-        # mesh — same layout as the single-chip TableState.
-        self.rows = jax.device_put(
-            jnp.zeros((capacity, 5), jnp.uint32), row_sharded
-        )
         self.count = jax.device_put(
             jnp.zeros((self.n_shards,), jnp.int32), row_sharded
         )
@@ -336,6 +407,7 @@ class ShardedDedup:
             cap=cap,
             num_issuers=self.num_issuers,
             max_probes=self.max_probes,
+            bucket=self.layout == "bucket",
             axis=self.axis,
         )
         A = P(self.axis)
@@ -400,9 +472,14 @@ class ShardedDedup:
         if fn is not None:
             return fn
 
+        bucket = self.layout == "bucket"
+
         def local(table_rows, table_count, send, meta, valid):
-            state = hashtable.TableState(table_rows, table_count)
-            state, _, overflow = hashtable.insert(
+            if bucket:
+                state = buckettable.BucketTable(table_rows, table_count)
+            else:
+                state = hashtable.TableState(table_rows, table_count)
+            state, _, overflow = pipeline.table_insert(
                 state, send[0], meta[0], valid[0], max_probes=self.max_probes
             )
             return (
@@ -473,12 +550,18 @@ class ShardedDedup:
         host lane's cross-domain dedup guard."""
         if fps_np.size == 0:
             return np.zeros((0,), bool)
-        return np.asarray(_contains_global(
+        fn = (_contains_global_bucket if self.layout == "bucket"
+              else _contains_global)
+        return np.asarray(fn(
             self.rows, jnp.asarray(fps_np.astype(np.uint32)),
             n_shards=self.n_shards, max_probes=self.max_probes,
         ))
 
     def drain_np(self) -> tuple[np.ndarray, np.ndarray]:
+        if self.layout == "bucket":
+            return buckettable.drain_np(
+                buckettable.BucketTable(self.rows, self.count)
+            )
         return hashtable.drain_np(
             hashtable.TableState(self.rows, self.count)
         )

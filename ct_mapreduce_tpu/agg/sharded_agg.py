@@ -64,9 +64,11 @@ class ShardedAggregator(TpuAggregator):
                     file=sys.stderr,
                 )
             grow_at = 0.0
+        from ct_mapreduce_tpu.agg.sharded import mesh_capacity
+
         self.dedup = ShardedDedup(
             mesh,
-            capacity=capacity,
+            capacity=mesh_capacity(n, capacity),
             base_hour=base_hour,
             max_probes=max_probes,
             dispatch_factor=dispatch_factor,
@@ -81,6 +83,8 @@ class ShardedAggregator(TpuAggregator):
             grow_at=grow_at,
             max_capacity=max_capacity,
         )
+        # Load-factor arithmetic runs on the mesh-rounded slot count.
+        self.capacity = self.dedup.capacity
 
     # -- hooks -----------------------------------------------------------
     def _make_table(self, capacity: int):
@@ -130,10 +134,15 @@ class ShardedAggregator(TpuAggregator):
     def save_checkpoint(self, path: str) -> None:
         import jax.numpy as jnp
 
-        from ct_mapreduce_tpu.ops import hashtable
+        from ct_mapreduce_tpu.ops import buckettable, hashtable
 
-        # Gather the sharded table to host once, reuse the parent format.
-        self.table = hashtable.TableState(
+        # Gather the sharded table to host once, reuse the parent
+        # format (the state type must match the dedup's layout so the
+        # codec writes the right positional keys/meta + layout field).
+        state_cls = (buckettable.BucketTable
+                     if self.dedup.layout == "bucket"
+                     else hashtable.TableState)
+        self.table = state_cls(
             rows=jnp.asarray(np.asarray(self.dedup.rows)),
             count=jnp.asarray(np.asarray(self.dedup.count)),
         )

@@ -30,6 +30,31 @@ from ct_mapreduce_tpu.core import packing
 from ct_mapreduce_tpu.ops import buckettable, der_kernel, hashtable, sha256
 
 
+def table_layout() -> str:
+    """Dedup-table layout: ``bucket`` (default — the sort-based
+    24-slot-bucket table the round-4 hardware measurements favor by
+    ~an order of magnitude on the insert, ops/buckettable.py) or
+    ``open`` (slot-granular open addressing, ops/hashtable.py)."""
+    import os
+
+    layout = os.environ.get("CTMR_TABLE", "bucket").strip().lower()
+    if layout not in ("bucket", "open"):
+        import warnings
+
+        warnings.warn(
+            f"ignoring CTMR_TABLE={layout!r} (want bucket|open); "
+            "using bucket", stacklevel=2)
+        return "bucket"
+    return layout
+
+
+def make_table(capacity: int, layout: str | None = None):
+    """Fresh dedup table in the selected layout."""
+    if (layout or table_layout()) == "bucket":
+        return buckettable.make_table(capacity)
+    return hashtable.make_table(capacity)
+
+
 def table_insert(table, keys, meta, valid, max_probes: int = 32):
     """Insert-if-absent on either dedup-table layout.
 
