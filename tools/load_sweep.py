@@ -85,7 +85,10 @@ def main() -> int:
 
     _fetch = jax.jit(lambda a: a + a.dtype.type(0))
 
-    table = hashtable.make_table(capacity)
+    # The shipping layout (CTMR_TABLE, default bucket) — load curves
+    # must describe the table production runs.
+    table = pipeline.make_table(capacity)
+    capacity = getattr(table, "capacity", capacity)
     fresh = jax.device_put(np.int32(0))
 
     # Compile + calibrate with one sweep.

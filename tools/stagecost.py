@@ -90,6 +90,16 @@ def main() -> None:
     def s_pack(data, length):
         return der_kernel.pack_rows(data).words.sum()
 
+    def s_pack2(data, length):
+        # Experimental formulation: bitcast u8[B, L] -> u32[B, L/4]
+        # (little-endian grouping) + in-register byteswap to the
+        # big-endian words pack_rows produces via strided slices.
+        le = jax.lax.bitcast_convert_type(
+            data.reshape(data.shape[0], -1, 4), jnp.uint32)
+        be = ((le & 0xFF) << 24) | ((le & 0xFF00) << 8) \
+            | ((le >> 8) & 0xFF00) | (le >> 24)
+        return be.sum()
+
     def _parse(data, length):
         rows = der_kernel.pack_rows(data)
         p = der_kernel.parse_certs_rows(rows, length, scan_issuer_cn=False)
@@ -194,7 +204,8 @@ def main() -> None:
         return dt
 
     stages = [
-        ("read", s_read), ("pack", s_pack), ("parse", s_parse),
+        ("read", s_read), ("pack", s_pack), ("pack2", s_pack2),
+        ("parse", s_parse),
         ("serial", s_serial), ("sha", s_sha), ("lanes", s_lanes),
     ]
     results = {}
