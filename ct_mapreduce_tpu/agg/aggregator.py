@@ -229,7 +229,7 @@ class TpuAggregator:
         cn_prefixes: tuple[str, ...] = (),
         max_probes: int = 32,
         now: Optional[datetime] = None,
-        grow_at: float = 0.7,
+        grow_at: float = 0.55,
         max_capacity: int = 1 << 28,
     ) -> None:
         self.table = self._make_table(capacity)
@@ -244,10 +244,12 @@ class TpuAggregator:
         # power of two (up to max_capacity; past the cap, probe
         # overflow spills lanes to the exact host lane with the
         # `overflow` metric — counts stay exact either way). grow_at
-        # <= 0 disables growth. A full log replay lives at high load;
-        # insert cost rises with load factor, so unbounded fill would
-        # silently degrade the measured rate (r03 hardware run:
-        # per-chunk time grew 4.92s → 7.12s by 36% load).
+        # <= 0 disables growth. The default 0.55 sits just below the
+        # measured knee of the bucket table's load curve (one v5e,
+        # docs/load_sweep_r04_bucket.log: 3.58M entries/s at 25% load,
+        # 2.20M at 50%, 0.63M at 75% — past ~55% the Poisson tail of
+        # full 24-slot buckets forces hop rounds), so steady state
+        # operates in the 27-55% band at 2.2-3.6M/s.
         self.grow_at = grow_at
         if max_capacity & (max_capacity - 1):
             # Growth targets double from a power-of-two capacity; a

@@ -33,7 +33,7 @@ class ShardedAggregator(TpuAggregator):
         max_probes: int = 32,
         now: Optional[datetime] = None,
         dispatch_factor: float = 2.0,
-        grow_at: float = 0.7,
+        grow_at: float = 0.55,
         max_capacity: int = 1 << 28,
     ) -> None:
         self.mesh = mesh
