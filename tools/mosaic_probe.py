@@ -84,14 +84,24 @@ def _cert_rows() -> tuple[np.ndarray, np.ndarray]:
 
 def _read_vec(w, off, clip=False):
     """Shared one-hot byte read (big-endian word packing, the same
-    convention as ops/der_kernel.py): int32[LANES] byte values."""
+    convention as ops/der_kernel.py): int32[LANES] byte values.
+
+    The reduction runs in INT32 over a bitcast of the uint32 words —
+    exact for a one-hot sum (single nonzero term, bit pattern
+    preserved) and required by Mosaic, whose 2026-07 toolchain names
+    the old formulation's failure outright: "Reductions over unsigned
+    integers not implemented" (the silent r03 crash, finally
+    diagnosed)."""
+    import jax
     import jax.numpy as jnp
 
     if clip:
         off = jnp.clip(off, 0, WORDS * 4 - 1)
     widx = off // 4
     sel = (jnp.arange(WORDS, dtype=jnp.int32)[:, None] == widx[None, :])
-    word = jnp.sum(jnp.where(sel, w, 0).astype(jnp.uint32), axis=0)
+    w_i32 = jax.lax.bitcast_convert_type(w, jnp.int32)
+    word = jax.lax.bitcast_convert_type(
+        jnp.sum(jnp.where(sel, w_i32, 0), axis=0), jnp.uint32)
     shift = (3 - (off % 4)) * 8
     return ((word >> shift.astype(jnp.uint32)) & 0xFF).astype(jnp.int32)
 
@@ -121,12 +131,7 @@ def k_onehot_read(w_ref, o_ref):
 
     w = w_ref[...]
     off = jnp.full((LANES,), 17, jnp.int32)  # byte offset per lane
-    widx = off // 4
-    sel = (jnp.arange(WORDS, dtype=jnp.int32)[:, None] == widx[None, :])
-    word = jnp.sum(jnp.where(sel, w, 0).astype(jnp.uint32), axis=0)
-    shift = (3 - (off % 4)) * 8
-    byte = (word >> shift.astype(jnp.uint32)) & 0xFF
-    o_ref[...] = byte.astype(jnp.int32)[None, :]
+    o_ref[...] = _read_vec(w, off)[None, :]
 
 
 def k_onehot_dyn(w_ref, o_ref):
@@ -136,12 +141,7 @@ def k_onehot_dyn(w_ref, o_ref):
 
     w = w_ref[...]
     first = (w[0] >> 24).astype(jnp.int32) % (WORDS * 4 - 4)
-    widx = first // 4
-    sel = (jnp.arange(WORDS, dtype=jnp.int32)[:, None] == widx[None, :])
-    word = jnp.sum(jnp.where(sel, w, 0).astype(jnp.uint32), axis=0)
-    shift = (3 - (first % 4)) * 8
-    o_ref[...] = ((word >> shift.astype(jnp.uint32)) & 0xFF).astype(
-        jnp.int32)[None, :]
+    o_ref[...] = _read_vec(w, first)[None, :]
 
 
 def k_fori_reads(w_ref, o_ref):
@@ -181,13 +181,17 @@ def k_fori_masked(w_ref, o_ref):
 
 
 def k_uint_reduce(w_ref, o_ref):
-    """Stage 5: UNSIGNED-integer reduction (round 3: unsupported in
-    some forms; walker avoided it via int32 casts)."""
+    """Stage 5: the uint32-reduction WORKAROUND — bitcast to int32,
+    reduce, bitcast back. A raw `jnp.sum(uint32)` is what Mosaic
+    rejects ("Reductions over unsigned integers not implemented",
+    diagnosed 2026-07-31 — the construct behind r03's silent crash);
+    this stage proves the replacement compiles and matches."""
+    import jax
     import jax.numpy as jnp
 
     w = w_ref[...]
-    s = jnp.sum(w & jnp.uint32(0xFF), axis=0)  # uint32 reduction
-    o_ref[...] = s.astype(jnp.int32)[None, :]
+    masked = jax.lax.bitcast_convert_type(w & jnp.uint32(0xFF), jnp.int32)
+    o_ref[...] = jnp.sum(masked, axis=0).astype(jnp.int32)[None, :]
 
 
 def k_while_early_exit(w_ref, o_ref):
