@@ -454,6 +454,11 @@ def main() -> int:
 
     rate = processed / elapsed
 
+    # Test hook for the launcher's silent-death insurance: die the way
+    # the 2026-07-31 run did — measured, logged, never emitted.
+    if os.environ.get("CT_BENCH_TEST_DIE") == "post-measure":
+        os.kill(os.getpid(), signal.SIGKILL)
+
     # -- end-to-end replay benchmark (BASELINE configs' ingest path) --
     # Wire-format entries → native C++ leaf decode → pack → H2D →
     # fused device step → readback, through the production
@@ -723,7 +728,73 @@ def run_e2e() -> dict:
     }
 
 
+def launcher() -> int:
+    """Scoreboard insurance: run the real bench as a CHILD process and
+    guarantee stdout carries one JSON line even if the child dies
+    without a word.
+
+    Observed once on this stack (2026-07-31): a bench run vanished
+    mid-e2e — no exception, no watchdog message, no OOM-kill record —
+    after the headline rate was measured and logged to stderr but
+    before the JSON line printed. An in-process defense cannot survive
+    a SIGKILL-class death, so this tiny parent (no jax import, not a
+    plausible kill target) relays the child's stderr, remembers the
+    last heartbeat rate, and emits a partial-rate JSON itself if the
+    child exits silently.
+    """
+    import re
+    import subprocess
+
+    env = dict(os.environ, CT_BENCH_INNER="1")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=env, text=True, bufsize=1,
+    )
+    state = {"rate": 0.0, "processed": 0, "elapsed": 0.0}
+    rate_re = re.compile(
+        r"chunk \d+: (\d+) entries in ([\d.]+)s cumulative ([\d,]+) ")
+
+    def pump_stderr():
+        for line in proc.stderr:
+            sys.stderr.write(line)
+            sys.stderr.flush()
+            m = rate_re.search(line)
+            if m:
+                state["processed"] = int(m.group(1))
+                state["elapsed"] = float(m.group(2))
+                state["rate"] = float(m.group(3).replace(",", ""))
+
+    t = threading.Thread(target=pump_stderr, daemon=True)
+    t.start()
+    out = proc.stdout.read()
+    rc = proc.wait()
+    t.join(timeout=5)
+    json_line = next(
+        (ln for ln in out.splitlines() if ln.startswith("{")), None)
+    if json_line is not None:
+        print(json_line, flush=True)
+        return rc
+    # Child died without emitting: surface the partial measured rate
+    # (never a bare 0 once a chunk completed), like the watchdog does.
+    if state["rate"] > 0:
+        emit({
+            "metric": "ct_entries_per_sec_per_chip",
+            "value": state["rate"],
+            "unit": "entries/s/chip",
+            "vs_baseline": round(state["rate"] / 10_000_000, 4),
+            "error": (
+                f"partial: bench child exited rc={rc} without emitting "
+                f"({state['processed']} entries in {state['elapsed']:.1f}s)"),
+        })
+    else:
+        emit_error(f"bench child exited rc={rc} before any measurement")
+    return 1
+
+
 if __name__ == "__main__":
+    if os.environ.get("CT_BENCH_INNER") != "1":
+        sys.exit(launcher())
     # Whatever happens, stdout carries exactly one JSON line: a real
     # metric on success, a structured {"error": ...} on failure — never
     # a bare traceback (round 1's rc=1 left the driver nothing to parse).

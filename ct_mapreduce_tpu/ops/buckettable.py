@@ -343,13 +343,16 @@ def insert(
     (_, rows, h_fin, _, _, _, _, _, ln_fin, flags_fin) = jax.lax.while_loop(
         cond, round_body, carry)
 
-    # Unsort the per-lane outcome in ONE scalar scatter: lanes that
-    # left the loop still pending (round budget) also overflow.
+    # Unsort the per-lane outcome by SORTING on the carried lane ids
+    # (a permutation of 0..b-1, so the sort reproduces lane order
+    # exactly). A sort is the cheap primitive on this hardware — 2.6
+    # vs 13 ns/lane for the equivalent scatter (tools/randacc.py).
+    # Lanes that left the loop still pending (round budget) also
+    # overflow.
     res_sorted = (
         flags_fin
         | jnp.where(h_fin < sentinel, jnp.uint32(4), jnp.uint32(0)))
-    packed = jnp.zeros((b,), jnp.uint32).at[ln_fin].set(
-        res_sorted, mode="drop")
+    _, packed = jax.lax.sort((ln_fin, res_sorted), num_keys=1)
     was_unknown = (packed & 2) != 0
     overflowed = (packed & 4) != 0
     new_count = state.count + jnp.sum(was_unknown, dtype=jnp.int32)

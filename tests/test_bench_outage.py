@@ -77,3 +77,28 @@ def test_watchdog_mid_measurement_emits_partial_rate():
     assert j["value"] > 0, j
     assert j["error"].startswith("partial: watchdog")
     assert j["vs_baseline"] > 0
+
+
+def test_silent_child_death_emits_partial_rate():
+    """The launcher's insurance (observed 2026-07-31: a run vanished
+    mid-e2e with the headline measured but never emitted): a child
+    killed with SIGKILL after the timed phase must still yield one
+    stdout JSON line carrying the partial measured rate."""
+    rc, j = _run_bench(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "CT_BENCH_E2E": "0",
+            "CT_BENCH_BATCH": "16384",
+            "CT_BENCH_LOG2_CAPACITY": "24",
+            "CT_BENCH_EXEC_SECS": "2",
+            "CT_BENCH_SECS": "4",
+            "CT_BENCH_WATCHDOG_SECS": "280",
+            "CT_BENCH_TEST_DIE": "post-measure",
+        },
+        timeout=420,
+    )
+    assert rc == 1
+    assert j["metric"] == "ct_entries_per_sec_per_chip"
+    assert j["value"] > 0, j
+    assert "without emitting" in j["error"]
+    assert j["vs_baseline"] > 0

@@ -1,0 +1,112 @@
+"""Capture a jax.profiler trace of the fused step and print top ops.
+
+Runs a few full-step sweeps under ``jax.profiler.trace`` on the real
+chip (same honest structure as stagecost's `full` stage), then parses
+the captured xplane proto with the installed xprof/tensorboard-profile
+tooling and prints the top device ops by self time — the op-level
+truth that stage-subtraction probes cannot see.
+
+Run:  python tools/profstep.py [batch] [outdir]
+"""
+
+from __future__ import annotations
+
+import functools
+import glob
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def say(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def capture(batch: int, outdir: str) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from ct_mapreduce_tpu.core import packing
+    from ct_mapreduce_tpu.ops import pipeline
+    from ct_mapreduce_tpu.utils import syncerts
+
+    cap = 1 << int(os.environ.get("CT_SC_LOG2_CAP", "26"))
+    dev = jax.devices()[0]
+    say(f"device: {dev.platform} ({dev.device_kind}); batch={batch}")
+
+    tpl = syncerts.make_template()
+    datas, lens = syncerts.build_device_batches(tpl, 1, batch, 1024)
+    issuer_idx = jax.device_put(np.zeros((batch,), np.int32))
+    valid = jax.device_put(np.ones((batch,), bool))
+    epoch_cols = tpl.serial_off + np.arange(4, 8, dtype=np.int32)
+    no_cn = np.zeros((0, 32), np.uint8)
+    no_cn_lens = np.zeros((0, 2), np.int32)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def mega(table, acc, n_sweeps, datas, lens, issuer_idx, valid):
+        def body(s, carry):
+            table, acc = carry
+            e = acc + jnp.uint32(s)
+            eb = jnp.stack(
+                [(e >> 24) & 0xFF, (e >> 16) & 0xFF, (e >> 8) & 0xFF,
+                 e & 0xFF]).astype(jnp.uint8)
+            data = datas[0].at[:, epoch_cols].set(eb[None, :])
+            table, out = pipeline.ingest_core(
+                table, data, lens[0], issuer_idx, valid,
+                jnp.int32(500_000), jnp.int32(packing.DEFAULT_BASE_HOUR),
+                no_cn, no_cn_lens)
+            return table, acc + out.was_unknown.sum().astype(jnp.uint32)
+        return jax.lax.fori_loop(0, n_sweeps, body, (table, acc))
+
+    fetch = jax.jit(lambda a: a + jnp.uint32(0))
+    table = pipeline.make_table(cap)
+    acc = jax.device_put(np.uint32(0))
+    t0 = time.perf_counter()
+    table, acc = mega(table, acc, np.int32(1), datas, lens, issuer_idx, valid)
+    int(fetch(acc))
+    say(f"compile+warmup {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    with jax.profiler.trace(outdir):
+        table, acc = mega(table, acc, np.int32(4), datas, lens,
+                          issuer_idx, valid)
+        int(fetch(acc))
+    say(f"profiled 4 sweeps in {time.perf_counter() - t0:.1f}s")
+
+
+def report(outdir: str, top: int = 40) -> None:
+    paths = glob.glob(os.path.join(outdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        say(f"no xplane.pb under {outdir}")
+        return
+    path = max(paths, key=os.path.getmtime)
+    say(f"parsing {path}")
+    from xprof.convert import _pywrap_profiler_plugin as pp
+
+    try:
+        raw = pp.xspace_to_tools_data([path], "op_profile")
+    except Exception as err:
+        say(f"op_profile failed ({err}); trying overview")
+        raw = pp.xspace_to_tools_data([path], "overview_page")
+    data = raw[0] if isinstance(raw, tuple) else raw
+    out = data.decode("utf-8", "replace") if isinstance(data, bytes) else str(data)
+    print(out[: 20000])
+
+
+def main() -> None:
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    outdir = sys.argv[2] if len(sys.argv) > 2 else "/tmp/ctmr_trace"
+    if os.environ.get("CT_PROF_REPORT_ONLY") != "1":
+        capture(batch, outdir)
+    report(outdir)
+
+
+if __name__ == "__main__":
+    main()
