@@ -1,5 +1,11 @@
 """Probe-width x batch-width sweep of the dedup insert on real TPU.
 
+UNRELIABLE ON THIS STACK — kept for history. Timings here rely on
+``jax.block_until_ready``, which the tunneled axon backend does not
+honor (measured 2026-07-31: a 1 GB parse "in 0.18 ms" = 7x HBM
+bandwidth). Use tools/stagecost.py / tools/randacc.py, which time
+with bench.py's synchronous-read contract.
+
 For each (PROBE_WIDTH, batch) combination this re-execs itself so the
 width (a module-load-time constant) recompiles cleanly, then times
 all-fresh inserts exactly like tools/microbench.py. Run with no args

@@ -1,5 +1,11 @@
 """Per-component device-step microbenchmark on the real chip.
 
+UNRELIABLE ON THIS STACK — kept for history. Timings here rely on
+``jax.block_until_ready``, which the tunneled axon backend does not
+honor (measured 2026-07-31: a 1 GB parse "in 0.18 ms" = 7x HBM
+bandwidth). Use tools/stagecost.py / tools/randacc.py, which time
+with bench.py's synchronous-read contract.
+
 Times, at one batch width, the stages of the fused step in isolation:
   h2d     — fixed 64 MB device_put probe (tunnel/PCIe bandwidth;
             batch bytes themselves are synthesized on device)

@@ -1,9 +1,13 @@
 """Raw XLA op-cost probe for the random-access primitives the dedup
 table is built from: gather / scatter / scatter-min on an HBM-resident
-table, at several table sizes, plus batch sort. Each measurement runs
-R repetitions of the op INSIDE one jitted fori_loop (so per-dispatch
-overhead is excluded — same structure as the bench's mega_step) and
-reports per-op device time. Prints immediately per stage.
+table, at several table sizes, plus batch sort.
+
+UNRELIABLE ON THIS STACK — kept for history. Despite the fori_loop
+structure, measurements here disagree with the trusted probes by
+orders of magnitude (2026-07-31 hardware run reported 0.002 ms for
+ops tools/randacc.py prices at 13-15 ms with synchronous value
+reads); loop-invariant operands likely let XLA hoist the op under
+test. Use tools/randacc.py / tools/stagecost.py instead.
 
 Run: python tools/opcost.py [batch]
 """
