@@ -1,0 +1,70 @@
+"""Cross-layout checkpoint compatibility: the snapshot's layout wins.
+
+A checkpoint written under either dedup-table layout must restore and
+keep exact counts regardless of the CTMR_TABLE value at load time —
+slot positions are only meaningful in the structure that wrote them,
+so load_checkpoint rebuilds the WRITER's layout and every downstream
+op dispatches on the state type (pipeline.table_insert).
+"""
+
+import datetime
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from ct_mapreduce_tpu.agg import TpuAggregator
+from ct_mapreduce_tpu.ops import buckettable, hashtable
+
+from certgen import make_cert
+
+UTC = datetime.timezone.utc
+NOW = datetime.datetime(2024, 6, 1, tzinfo=UTC)
+
+
+def entries(n, issuer_cn, base=5000):
+    # NOTE: certgen reuses one keypair, so every "issuer" here shares
+    # one SPKI digest — i.e. ONE identity (the reference keys issuers
+    # by SHA-256(SPKI), /root/reference/storage/types.go:104-141, not
+    # by DN). Distinct serial bases are what make entries distinct.
+    ca = make_cert(issuer_cn=issuer_cn)
+    return [
+        (make_cert(serial=base + i, issuer_cn=issuer_cn, is_ca=False,
+                   subject_cn=f"x{i}.example.com"), ca)
+        for i in range(n)
+    ]
+
+
+@pytest.mark.parametrize("writer,reader", [
+    ("open", "bucket"), ("bucket", "open"),
+])
+def test_checkpoint_layout_survives_env_change(monkeypatch, writer, reader):
+    monkeypatch.setenv("CTMR_TABLE", writer)
+    a = TpuAggregator(capacity=1 << 10, batch_size=64, now=NOW)
+    ents = entries(150, f"Layout CA {writer}")
+    res = a.ingest(ents)
+    assert res.was_unknown.all()
+    fd, path = tempfile.mkstemp(suffix=".npz")
+    os.close(fd)
+    try:
+        a.save_checkpoint(path)
+
+        monkeypatch.setenv("CTMR_TABLE", reader)
+        b = TpuAggregator(capacity=1 << 10, batch_size=64, now=NOW)
+        b.load_checkpoint(path)
+        # The restored table keeps the WRITER's structure.
+        want_cls = (buckettable.BucketTable if writer == "bucket"
+                    else hashtable.TableState)
+        assert isinstance(b.table, want_cls)
+        # Everything from before the restart is known...
+        res2 = b.ingest(ents)
+        assert not res2.was_unknown.any()
+        # ...new entries insert through the dispatched path...
+        more = entries(60, f"Layout CA {writer} 2", base=9000)
+        res3 = b.ingest(more)
+        assert res3.was_unknown.all()
+        # ...and the drained totals stay exact.
+        assert b.drain().total == 210
+    finally:
+        os.unlink(path)

@@ -1,0 +1,46 @@
+"""cProfile harness for the e2e store+flush path on the real chip.
+
+Builds three 64K-entry wire-format batches, warms the compiled step,
+then profiles two batches through AggregatorSink — the tool that found
+the round-4 e2e readback pathologies (twelve per-chunk device reads,
+the 64 MB device-batch re-fetch, the np.unique(axis=0) lexsort; see
+BENCHLOG round 4). Run on TPU:  python tools/e2eprof.py
+"""
+import base64, cProfile, pstats, sys, time, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+from ct_mapreduce_tpu.ingest import leaf as leaflib
+from ct_mapreduce_tpu.ingest.sync import AggregatorSink, RawBatch
+from ct_mapreduce_tpu.utils import syncerts
+
+batch = 65536
+tpls = [syncerts.make_template(issuer_cn=f"Bench Issuer {k}") for k in range(2)]
+eds_cache = [base64.b64encode(leaflib.encode_extra_data([t.issuer_der])).decode() for t in tpls]
+def mk(i):
+    lis, eds = [], []
+    for j in range(batch):
+        k = j & 1
+        der = syncerts.stamp_serial(tpls[k], i * batch + j)
+        lis.append(base64.b64encode(leaflib.encode_leaf_input(der, 1_700_000_000_000 + j)).decode())
+        eds.append(eds_cache[k])
+    return RawBatch(lis, eds, i * batch, "bench-log")
+
+rb0, rb1, rb2 = mk(0), mk(1), mk(2)
+cap = 1 << 19
+agg = TpuAggregator(capacity=cap, batch_size=batch)
+sink = AggregatorSink(agg, flush_size=batch, device_queue_depth=2)
+t0=time.perf_counter(); sink.store_raw_batch(rb0); sink.flush()
+print(f"warm {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+pr = cProfile.Profile()
+pr.enable()
+t0=time.perf_counter()
+sink.store_raw_batch(rb1)
+sink.store_raw_batch(rb2)
+sink.flush()
+dt = time.perf_counter()-t0
+pr.disable()
+print(f"2 batches in {dt:.2f}s = {2*batch/dt:,.0f}/s", file=sys.stderr)
+st = pstats.Stats(pr, stream=sys.stderr)
+st.sort_stats('cumulative').print_stats(25)
