@@ -29,8 +29,10 @@ echo "=== pool up $(date); running value-ordered ladder ===" >> "$log"
 
 echo "--- [1] bench default (2^20 fused rows, full e2e) ---" >> "$log"
 CT_BENCH_WATCHDOG_SECS=520 timeout 1200 python bench.py >> "$log" 2>&1
-echo "--- [2] microbench 1048576 (reworked walker) ---" >> "$log"
-timeout 1500 python tools/microbench.py 1048576 >> "$log" 2>&1
+echo "--- [2] stagecost 1048576 (trusted per-stage) ---" >> "$log"
+timeout 2400 python tools/stagecost.py 1048576 >> "$log" 2>&1
+echo "--- [2b] randacc (trusted primitive prices) ---" >> "$log"
+timeout 2400 python tools/randacc.py >> "$log" 2>&1
 echo "--- [3a] bench 2^22 lanes ---" >> "$log"
 CT_BENCH_BATCH=4194304 CT_BENCH_WATCHDOG_SECS=520 CT_BENCH_E2E=0 \
   timeout 1200 python bench.py >> "$log" 2>&1
@@ -41,15 +43,13 @@ echo "--- [4] load_sweep 24 ---" >> "$log"
 timeout 3000 python tools/load_sweep.py 24 0.10 0.25 0.50 0.75 >> "$log" 2>&1
 echo "--- [5] hardware test tier ---" >> "$log"
 CT_TPU_TESTS=1 timeout 2400 python -m pytest tests/test_tpu_hw.py -v >> "$log" 2>&1
-echo "--- [6a] insert_sweep ---" >> "$log"
-timeout 3000 python tools/insert_sweep.py >> "$log" 2>&1
-echo "--- [6b] opcost 131072 ---" >> "$log"
-timeout 1500 python tools/opcost.py 131072 >> "$log" 2>&1
-echo "--- [6c] sha_sweep ---" >> "$log"
-timeout 1800 python tools/sha_sweep.py >> "$log" 2>&1
-echo "--- [6d] mosaic_probe compiled ---" >> "$log"
+echo "--- [6a] profstep (op-level trace) ---" >> "$log"
+timeout 1800 python tools/profstep.py >> "$log" 2>&1
+echo "--- [6b] e2eprof ---" >> "$log"
+timeout 1800 python tools/e2eprof.py >> "$log" 2>&1
+echo "--- [6c] mosaic_probe compiled ---" >> "$log"
 timeout 1800 python tools/mosaic_probe.py >> "$log" 2>&1
-echo "--- [6e] bench PROBE_WIDTH=8 ---" >> "$log"
-CTMR_PROBE_WIDTH=8 CT_BENCH_WATCHDOG_SECS=520 CT_BENCH_E2E=0 \
+echo "--- [6d] bench CTMR_TABLE=open (layout comparison) ---" >> "$log"
+CTMR_TABLE=open CT_BENCH_WATCHDOG_SECS=520 CT_BENCH_E2E=0 \
   timeout 1200 python bench.py >> "$log" 2>&1
 echo "=== ladder4 done $(date) ===" >> "$log"
