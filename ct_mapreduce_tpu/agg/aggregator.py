@@ -89,11 +89,14 @@ class IssuerRegistry:
             iid = issuer.id()
             idx = self._by_issuer_id.get(iid)
             if idx is None:
+                # Indices are unbounded: only the DEVICE meta word packs
+                # the issuer in META_ISSUER_BITS, and the pipeline's
+                # idx_ok gate (ops/pipeline.py) already routes lanes
+                # with idx >= MAX_ISSUERS to the exact host lane, which
+                # keys by plain ints — so a full-log replay that blows
+                # past 16,384 issuers degrades to host-exact counting
+                # for the excess issuers instead of crashing ingest.
                 idx = len(self._issuers)
-                if idx >= packing.MAX_ISSUERS:
-                    raise RuntimeError(
-                        f"issuer registry full ({packing.MAX_ISSUERS})"
-                    )
                 self._issuers.append(issuer)
                 self._by_issuer_id[iid] = idx
             self._by_der[issuer_der] = idx
@@ -275,6 +278,15 @@ class TpuAggregator:
         grow_at: float = 0.55,
         max_capacity: int = 1 << 28,
     ) -> None:
+        if max_capacity & (max_capacity - 1):
+            # Growth targets double from a power-of-two capacity; a
+            # ragged ceiling would make grow() raise on every ingest
+            # once tripped. Round DOWN so the ceiling stays honest.
+            max_capacity = 1 << (max_capacity.bit_length() - 1)
+        # Set before the table exists: _make_table clamps the bucket
+        # layout's power-of-two round-up to this ceiling (rows are
+        # 512 B/bucket; a silent 2x overshoot would double HBM use).
+        self.max_capacity = max_capacity
         self.table = self._make_table(capacity)
         # Bucket tables round capacity up to whole buckets; load-factor
         # arithmetic must use the real slot count.
@@ -294,12 +306,6 @@ class TpuAggregator:
         # full 24-slot buckets forces hop rounds), so steady state
         # operates in the 27-55% band at 2.2-3.6M/s.
         self.grow_at = grow_at
-        if max_capacity & (max_capacity - 1):
-            # Growth targets double from a power-of-two capacity; a
-            # ragged ceiling would make grow() raise on every ingest
-            # once tripped. Round DOWN so the ceiling stays honest.
-            max_capacity = 1 << (max_capacity.bit_length() - 1)
-        self.max_capacity = max_capacity
         # Host-side running fill estimate: device inserts folded in at
         # complete() time, plus lanes currently in flight (upper
         # bound). Exact fill is read from the device only when the
@@ -336,8 +342,19 @@ class TpuAggregator:
     # -- state hooks (overridden by the mesh-sharded subclass) -----------
     def _make_table(self, capacity: int):
         if _table_layout() == "bucket":
-            return buckettable.make_table(capacity)
+            return buckettable.make_table(
+                capacity, max_capacity=self.max_capacity)
         return hashtable.make_table(capacity)
+
+    def _topology_shards(self) -> int:
+        """How many key-addressed shards this aggregator's table uses.
+
+        Checkpoint slot positions are only meaningful under the
+        topology that wrote them (a mesh-sharded writer addresses
+        dest * nb_local + local hash); the value is recorded in every
+        snapshot so a reader with a different topology re-hashes
+        instead of trusting positions."""
+        return 1
 
     def _drain_table(self) -> tuple[np.ndarray, np.ndarray]:
         if isinstance(self.table, buckettable.BucketTable):
@@ -680,7 +697,10 @@ class TpuAggregator:
         if dropped is not None:  # sharded path: routing-cap spill rate
             self.metrics["dispatch_spill"] += int(dropped.sum())
         self.metrics["overflow"] += int(ovf.sum())
-        self.issuer_totals += np.asarray(out.issuer_unknown_counts, np.int64)
+        # Device counts are MAX_ISSUERS-long; the host array may have
+        # grown past that for registry-overflow issuers (host-lane-only).
+        counts = np.asarray(out.issuer_unknown_counts, np.int64)
+        self.issuer_totals[: counts.shape[0]] += counts
 
         # Vectorized fold-in (the per-entry Python loop here was the e2e
         # ingest bottleneck): positions and lanes as index arrays, with
@@ -938,6 +958,14 @@ class TpuAggregator:
             return False, False, eh, fields.serial
         bucket.add(fields.serial)
         self.metrics["inserted"] += 1
+        if issuer_idx >= self.issuer_totals.shape[0]:
+            # Registry-overflow issuers (idx >= MAX_ISSUERS) only ever
+            # count here; grow the per-issuer totals to fit them.
+            grown = np.zeros(
+                (max(issuer_idx + 1, 2 * self.issuer_totals.shape[0]),),
+                np.int64)
+            grown[: self.issuer_totals.shape[0]] = self.issuer_totals
+            self.issuer_totals = grown
         self.issuer_totals[issuer_idx] += 1
         # Metadata for host-lane unknowns.
         self.dn_sets.setdefault(issuer_idx, set()).add(fields.issuer_dn)
@@ -1042,17 +1070,27 @@ class TpuAggregator:
     def _write_npz(self, fh, host_items) -> None:
         layout = ("bucket" if isinstance(self.table, buckettable.BucketTable)
                   else "open")
+        # ONE device fetch for the whole table: the .keys/.meta
+        # properties each pull rows through the tunnel (~0.5s per
+        # 64 MB D2H), so going through them would double checkpoint
+        # readback cost for multi-GB tables.
+        rows = np.asarray(self.table.rows)
+        if layout == "bucket":
+            slots = rows[:, : buckettable.SLOTS * 5].reshape(-1, 5)
+        else:
+            slots = rows
         np.savez_compressed(
             fh,
             # (keys, meta, count) stays the cross-version wire format;
             # `layout` records slot positioning (bucket i//SLOTS vs
-            # open-addressed chains) so restore rebuilds the same
-            # structure. Cross-layout restores go through the
-            # reinsertion path (ShardedDedup.bulk_insert_np /
-            # restore_into), which re-hashes rows for any layout.
+            # open-addressed chains) and `n_shards` the key-routing
+            # topology, so restore rebuilds the same structure — or
+            # re-hashes via the reinsertion path (_restore_table /
+            # ShardedDedup.bulk_insert_np) when either differs.
             layout=np.array(layout),
-            keys=np.asarray(self.table.keys),
-            meta=np.asarray(self.table.meta),
+            n_shards=np.int64(self._topology_shards()),
+            keys=slots[:, :4],
+            meta=slots[:, 4],
             count=np.asarray(self.table.count),
             registry=np.frombuffer(
                 self.registry.to_json().encode(), dtype=np.uint8
@@ -1085,29 +1123,63 @@ class TpuAggregator:
 
         return jnp.asarray(arr)
 
-    def load_checkpoint(self, path: str) -> None:
-        z = np.load(path, allow_pickle=True)
-        # Checkpoint format stays (keys, meta, count) for cross-version
-        # stability; `layout` (absent in pre-round-4 snapshots ⇒ open)
-        # says how slot positions map back to a table structure. The
-        # snapshot's layout wins over CTMR_TABLE: positions are only
-        # meaningful in the structure that wrote them.
-        layout = str(z["layout"]) if "layout" in z else "open"
+    def _restore_table(self, keys, meta, count, layout: str,
+                       ckpt_shards: int) -> None:
+        """Rebuild table state from checkpoint (keys, meta) rows.
+
+        Positions written by a matching topology restore as a raw row
+        copy; a snapshot from a different shard count (its slot
+        positions encode dest * nb_local + local hash, unreachable by
+        this topology's hashes) re-hashes every occupied row through
+        the reinsertion path instead — silent positional trust would
+        make contains/insert miss those keys and double-count."""
+        if ckpt_shards != self._topology_shards():
+            occ = keys.any(axis=-1)
+            self.capacity = self._rebuild_table(
+                max(int(keys.shape[0]), 1))
+            overflow = self._bulk_reinsert(keys[occ], meta[occ])
+            if overflow:
+                raise RuntimeError(
+                    f"checkpoint restore overflowed {overflow} rows "
+                    f"re-hashing a {ckpt_shards}-shard snapshot; "
+                    f"increase tableBits (capacity {self.capacity})"
+                )
+            self._table_fill = int(occ.sum())
+            return
         if layout == "bucket":
-            slots = hashtable.fuse_rows(z["keys"], z["meta"])
+            slots = hashtable.fuse_rows(keys, meta)
             nb = slots.shape[0] // buckettable.SLOTS
             rows = np.zeros((nb, buckettable.ROW_WORDS), np.uint32)
             rows[:, : buckettable.SLOTS * 5] = slots.reshape(nb, -1)
+            # The device insert trusts the cached fill word; positional
+            # snapshots (and pre-round-5 ones especially) don't carry it.
+            buckettable.fill_counts_np(rows)
             self.table = buckettable.BucketTable(
-                rows=self._asarray(rows), count=self._asarray(z["count"]),
+                rows=self._asarray(rows), count=self._asarray(count),
             )
             self.capacity = nb * buckettable.SLOTS
         else:
             self.table = hashtable.TableState(
-                rows=self._asarray(hashtable.fuse_rows(z["keys"], z["meta"])),
-                count=self._asarray(z["count"]),
+                rows=self._asarray(hashtable.fuse_rows(keys, meta)),
+                count=self._asarray(count),
             )
-            self.capacity = int(z["keys"].shape[0])
+            self.capacity = int(keys.shape[0])
+
+    def load_checkpoint(self, path: str) -> None:
+        z = np.load(path, allow_pickle=True)
+        # Checkpoint format stays (keys, meta, count) for cross-version
+        # stability; `layout` (absent in pre-round-4 snapshots ⇒ open)
+        # says how slot positions map back to a table structure, and
+        # `n_shards` (absent in pre-round-5 snapshots ⇒ 1) which
+        # key-routing topology wrote them. The snapshot's layout wins
+        # over CTMR_TABLE: positions are only meaningful in the
+        # structure that wrote them.
+        layout = str(z["layout"]) if "layout" in z else "open"
+        ckpt_shards = int(z["n_shards"]) if "n_shards" in z else 1
+        self._restore_table(
+            np.asarray(z["keys"]), np.asarray(z["meta"]),
+            np.asarray(z["count"]), layout, ckpt_shards,
+        )
         self._device_written = bool(np.asarray(z["count"]).sum() > 0)
         self._table_fill = int(np.asarray(z["count"]).sum())
         self._inflight_lanes = 0
@@ -1144,9 +1216,8 @@ class HostSnapshotAggregator(TpuAggregator):
 
     def _make_table(self, capacity: int):
         if _table_layout() == "bucket":
-            nb = 1 << max(
-                0, (capacity + buckettable.SLOTS - 1) // buckettable.SLOTS - 1
-            ).bit_length()
+            nb = buckettable.bucket_count(
+                capacity, max_capacity=self.max_capacity)
             return buckettable.BucketTable(
                 rows=np.zeros((nb, buckettable.ROW_WORDS), np.uint32),
                 count=np.zeros((), np.int32),
@@ -1160,6 +1231,21 @@ class HostSnapshotAggregator(TpuAggregator):
 
     def _asarray(self, arr: np.ndarray):
         return np.asarray(arr)
+
+    def _bulk_reinsert(self, keys: np.ndarray, meta: np.ndarray) -> int:
+        """Host-only reinsertion (topology-mismatched snapshots must
+        re-hash; a report process must not claim the device to do so)."""
+        if not isinstance(self.table, buckettable.BucketTable):
+            raise RuntimeError(
+                "host-only restore of a topology-mismatched open-layout "
+                "snapshot is not supported; restore through a device "
+                "aggregator (TpuAggregator/ShardedAggregator) instead")
+        rows = np.asarray(self.table.rows)
+        ovf = buckettable.bulk_insert_np(
+            rows, keys, meta, max_probes=self.max_probes)
+        self.table = buckettable.BucketTable(
+            rows=rows, count=np.int32(len(keys) - ovf))
+        return ovf
 
     # _drain_table is inherited: both layouts' drain_np helpers are
     # pure NumPy over this subclass's host-resident arrays.

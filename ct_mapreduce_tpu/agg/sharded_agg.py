@@ -130,6 +130,9 @@ class ShardedAggregator(TpuAggregator):
             cn_prefix_lens=self._prefix_lens,
         )
 
+    def _topology_shards(self) -> int:
+        return self.dedup.n_shards
+
     # -- checkpoint ------------------------------------------------------
     def save_checkpoint(self, path: str) -> None:
         import jax.numpy as jnp
@@ -138,7 +141,8 @@ class ShardedAggregator(TpuAggregator):
 
         # Gather the sharded table to host once, reuse the parent
         # format (the state type must match the dedup's layout so the
-        # codec writes the right positional keys/meta + layout field).
+        # codec writes the right positional keys/meta + layout +
+        # n_shards fields).
         state_cls = (buckettable.BucketTable
                      if self.dedup.layout == "bucket"
                      else hashtable.TableState)
@@ -151,16 +155,16 @@ class ShardedAggregator(TpuAggregator):
         finally:
             self.table = None
 
-    def load_checkpoint(self, path: str) -> None:
-        super().load_checkpoint(path)
+    def _restore_table(self, keys, meta, count, layout: str,
+                       ckpt_shards: int) -> None:
         # Restore by REINSERTION, not raw row copy: a checkpoint may come
-        # from a different topology (single chip, another mesh size), and
-        # both a key's home shard and its probe sequence depend on the
-        # topology — only re-hashing every occupied row is always correct.
-        keys_np = np.asarray(self.table.keys)
-        meta_np = np.asarray(self.table.meta)
-        occ = keys_np.any(axis=-1)
-        ckpt_cap = int(keys_np.shape[0])
+        # from a different topology (single chip, another mesh size) or
+        # layout, and a key's home shard, bucket, and probe sequence all
+        # depend on both — only re-hashing every occupied row is always
+        # correct. (A same-topology fast path could raw-copy, but
+        # restores are rare and reinsertion keeps one code path.)
+        occ = keys.any(axis=-1)
+        ckpt_cap = int(keys.shape[0])
         target_cap = max(self.dedup.capacity, ckpt_cap)
         self.dedup = ShardedDedup(
             self.mesh,
@@ -169,8 +173,7 @@ class ShardedAggregator(TpuAggregator):
             max_probes=self.max_probes,
             dispatch_factor=self.dedup.dispatch_factor,
         )
-        overflow = self.dedup.bulk_insert_np(keys_np[occ], meta_np[occ])
-        self._device_written = bool(occ.any()) or self._device_written
+        overflow = self.dedup.bulk_insert_np(keys[occ], meta[occ])
         if overflow:
             raise RuntimeError(
                 f"checkpoint restore overflowed {overflow} rows; "

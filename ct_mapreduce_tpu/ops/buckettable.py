@@ -17,10 +17,11 @@ nearly free. So:
 
 - The table is an array of BUCKETS: ``rows: uint32[n_buckets, 128]``,
   each row holding 24 slots x 5 words (4 fingerprint words + meta;
-  words 120..127 spare) — one gather fetches a whole bucket, one
-  scatter commits it, both tile-aligned.
-- Slots fill contiguously (0..fill-1), so occupancy is a scan, not a
-  header word.
+  word 120 caches the fill count, 121..127 spare) — one gather
+  fetches a whole bucket, one scatter commits it, both tile-aligned.
+- Slots fill contiguously (0..fill-1); the count rides in the row's
+  spare word 120, so occupancy is one column read per round instead
+  of a 24-slot scan.
 - Within-batch coordination is a SORT, not a scatter election: lanes
   sort by (bucket, key words, lane). Same-bucket lanes become
   adjacent, same-key lanes become adjacent-with-deterministic-first
@@ -66,6 +67,14 @@ import numpy as np
 
 SLOTS = 24  # slots per bucket (24 * 5 = 120 of 128 row words)
 ROW_WORDS = 128
+#: Spare row word caching the bucket's occupied-slot count. Slots fill
+#: contiguously, so the count used to be recomputed as a 24-iteration
+#: occupancy scan over the gathered row every insert round (~5 ns/entry
+#: of pure formulation cost, docs/profile_r04_step_ops.txt); caching it
+#: here makes `fill` a single column read. Every code path that builds
+#: rows outside `insert` (bulk_insert_np, checkpoint restore) must keep
+#: this word consistent — `fill_counts_np` recomputes it from occupancy.
+FILL_WORD = 120
 
 
 def _window_from_env() -> int:
@@ -97,6 +106,7 @@ class BucketTable(NamedTuple):
     ``rows[b]`` is bucket ``b``: 24 slots x (4 fingerprint words +
     meta word), filled contiguously; all-zero KEY words mark an empty
     slot (meta 0 is legal data, exactly as in hashtable.TableState).
+    Row word ``FILL_WORD`` caches the bucket's occupied-slot count.
     """
 
     rows: jax.Array  # uint32[n_buckets, 128]
@@ -126,16 +136,41 @@ class BucketTable(NamedTuple):
         return rows[:, : SLOTS * 5].reshape(-1, 5)[:, 4]
 
 
-def make_table(capacity: int) -> BucketTable:
-    """Table with at least ``capacity`` slots (n_buckets rounds up to
-    a power of two; real capacity is ``state.capacity``)."""
+def bucket_count(capacity: int, max_capacity: int | None = None) -> int:
+    """Power-of-two bucket count for ≥ ``capacity`` slots. When the
+    rounded-up slot count would exceed ``max_capacity`` (rows are 512 B
+    each, so a silent 2x round-up can double HBM use past the
+    configured bound), rounds DOWN instead."""
     if capacity < 1:
         raise ValueError(f"capacity must be positive, got {capacity}")
-    n_buckets = 1 << max(0, (capacity + SLOTS - 1) // SLOTS - 1).bit_length()
+    nb = 1 << max(0, (capacity + SLOTS - 1) // SLOTS - 1).bit_length()
+    if max_capacity is not None and nb * SLOTS > max_capacity:
+        while nb > 1 and nb * SLOTS > max_capacity:
+            nb >>= 1
+    return nb
+
+
+def make_table(capacity: int, max_capacity: int | None = None) -> BucketTable:
+    """Table with at least ``capacity`` slots (n_buckets rounds up to
+    a power of two; real capacity is ``state.capacity``). Pass
+    ``max_capacity`` to round down instead when the power-of-two
+    round-up would overshoot a configured ceiling."""
+    n_buckets = bucket_count(capacity, max_capacity)
     return BucketTable(
         rows=jnp.zeros((n_buckets, ROW_WORDS), dtype=jnp.uint32),
         count=jnp.zeros((), dtype=jnp.int32),
     )
+
+
+def fill_counts_np(rows_np: np.ndarray) -> np.ndarray:
+    """Recompute each bucket's occupied-slot count from key-word
+    occupancy and write it into ``FILL_WORD`` in place. Call after any
+    host-side row construction (checkpoint restore, bulk insert) so
+    the device insert's cached-fill invariant holds."""
+    slots = rows_np[:, : SLOTS * 5].reshape(rows_np.shape[0], SLOTS, 5)
+    fills = slots[:, :, :4].any(axis=-1).sum(axis=-1).astype(np.uint32)
+    rows_np[:, FILL_WORD] = fills
+    return fills
 
 
 def _desentinel(keys: jax.Array) -> jax.Array:
@@ -186,6 +221,13 @@ def insert(
     rows = state.rows
     nb = rows.shape[0]
     b = keys.shape[0]
+    if b > 1 << 25:
+        # The segment broadcast packs (sorted position, window count)
+        # as idx * 64 + w into one int32 cummax; position 2^25 is where
+        # that encoding would overflow and silently corrupt merges.
+        raise ValueError(
+            f"insert batch width {b} exceeds 2^25 lanes (the int32 "
+            "segment-broadcast encoding); split the batch")
     keys = _desentinel(keys.astype(jnp.uint32))
     h0 = _home_bucket(keys, nb)
     lane = jnp.arange(b, dtype=jnp.int32)
@@ -230,13 +272,15 @@ def insert(
         # 2^20 lanes for the stacked formulation of this very loop.
         row = rows[jnp.minimum(h, nb - 1)]  # [B, 128]
 
-        # Slot scan via per-column [B] slices of the gathered row.
-        fill = jnp.zeros((b,), jnp.int32)
+        # Occupancy is the cached fill word (slots fill contiguously;
+        # the 24-iteration occupancy scan this replaces was pure
+        # formulation cost). The match scan still walks all 24 slots:
+        # empty slots are all-zero and keys are desentineled nonzero,
+        # so matching against them is harmless.
+        fill = row[:, FILL_WORD].astype(jnp.int32)
         in_row = jnp.zeros((b,), bool)
         for s in range(SLOTS):
             w = [row[:, s * 5 + i] for i in range(4)]
-            occ_s = (w[0] | w[1] | w[2] | w[3]) != 0
-            fill = fill + occ_s.astype(jnp.int32)
             in_row = in_row | (
                 (w[0] == k0) & (w[1] == k1) & (w[2] == k2) & (w[3] == k3))
         in_row = pend & in_row
@@ -291,6 +335,16 @@ def insert(
         # [B]-vector broadcasts along the lane axis inside the fusion
         # (no [B, 1] materialization — see the layout rule above), and
         # candidates hold distinct slots, so the wheres commute.
+        #
+        # NOTE (round-5 negative result, measured via tools/insertcost
+        # A/B on one v5e): a "cheaper" two-pass variant — build each
+        # lane's own candidate block once, then OR the WINDOW-1
+        # following lanes' blocks into the head via [B, 128] row shifts
+        # — DOUBLED insert cost (130 vs 66 ns/entry at 2^20 lanes).
+        # Sublane-axis shifts of [B, 128] arrays are not tile-aligned,
+        # so each shifted copy materializes and the big loop fusion
+        # breaks. The WINDOW-unrolled select chain below stays the
+        # shipping formulation.
         col = jnp.arange(ROW_WORDS, dtype=jnp.int32)[None, :]  # [1, 128]
         outrow = row
         for j in range(WINDOW):
@@ -312,6 +366,11 @@ def insert(
                             _shift_up(mt, j, jnp.uint32(0))[:, None]))))
             sel = ok_j[:, None] & (off >= 0) & (off < 5)
             outrow = jnp.where(sel, val, outrow)
+        # The committed row also carries the updated fill count in its
+        # spare word (all w_seg in-window new keys hold consecutive
+        # ranks, so exactly min(w_seg, space) of them merge per round).
+        new_fill = (fill + jnp.minimum(w_seg, space)).astype(jnp.uint32)
+        outrow = jnp.where(col == FILL_WORD, new_fill[:, None], outrow)
 
         # One tile-aligned scatter per active bucket (heads hold
         # unique, sorted bucket ids — no duplicate indices).
@@ -383,14 +442,14 @@ def contains(state: BucketTable, keys: jax.Array,
         row = rows[h]  # [B, 128]
         # Per-column [B] slices, not a [B, SLOTS, 5] reshape — small
         # minor dims pad to 128 lanes on TPU (layout rule in insert).
+        # Emptiness comes from the cached fill word, not a slot scan.
         match = jnp.zeros((b,), bool)
-        has_empty = jnp.zeros((b,), bool)
         for s in range(SLOTS):
             w = [row[:, s * 5 + i] for i in range(4)]
             match = match | (
                 (w[0] == keys[:, 0]) & (w[1] == keys[:, 1])
                 & (w[2] == keys[:, 2]) & (w[3] == keys[:, 3]))
-            has_empty = has_empty | ((w[0] | w[1] | w[2] | w[3]) == 0)
+        has_empty = row[:, FILL_WORD].astype(jnp.int32) < SLOTS
         found = found | (open_ & match)
         open_ = open_ & ~match & ~has_empty
         h = jnp.where(open_, (h + 1) & (nb - 1), h)
@@ -439,12 +498,16 @@ def drain_np(state: BucketTable) -> tuple[np.ndarray, np.ndarray]:
 
 def bulk_insert_np(rows_np: np.ndarray, keys: np.ndarray,
                    meta: np.ndarray, max_probes: int = 32) -> int:
-    """Host-side rebuild: insert unique ``keys`` into ``rows_np`` in
-    place (restore / grow path). Returns the number of keys that
-    could not be placed within ``max_probes`` hops.
+    """Host-side rebuild: insert ``keys`` into ``rows_np`` in place
+    (the topology-mismatched checkpoint-restore path). Returns the
+    number of keys that could not be placed within ``max_probes``
+    hops. Callers must pass DEDUPLICATED keys not already present in
+    the table (drained dedup-set rows satisfy both by construction) —
+    no membership check is performed.
 
     Vectorized by rounds: bucket fills via bincount, per-bucket ranks
-    via argsort order, spillover hops to the next bucket.
+    via argsort order, spillover hops to the next bucket. Maintains
+    the ``FILL_WORD`` cache the device insert relies on.
     """
     nb = rows_np.shape[0]
     keys = keys.astype(np.uint32).reshape(-1, 4)
@@ -473,9 +536,8 @@ def bulk_insert_np(rows_np: np.ndarray, keys: np.ndarray,
         tgt = order[ok]
         slots[hs[ok], slot[ok], :4] = keys[tgt]
         slots[hs[ok], slot[ok], 4] = meta[tgt]
-        np.add.at(fill, hs[ok], 0)  # fills recomputed below per bucket
-        placed_per_bucket = np.bincount(hs[ok], minlength=nb)
-        fill += placed_per_bucket
+        fill += np.bincount(hs[ok], minlength=nb)
         alive[tgt] = False
         h[order[~ok]] = (h[order[~ok]] + 1) & (nb - 1)
+    rows_np[:, FILL_WORD] = fill.astype(np.uint32)
     return int(alive.sum())

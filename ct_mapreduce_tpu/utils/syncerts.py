@@ -41,13 +41,24 @@ def _build_pair(
     issuer_cn: str,
     not_after: datetime.datetime,
     crl_dp: str | None,
+    key_type: str = "ec",
+    serial_len: int = SERIAL_LEN,
+    rich_extensions: bool = False,
 ) -> tuple[bytes, bytes]:
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric import ec, rsa
     from cryptography.x509.oid import NameOID
 
-    key = ec.generate_private_key(ec.SECP256R1())
+    # Real CT logs are RSA-dominated (~1.2-1.9 KB DER vs ~0.8 KB for
+    # ECDSA P-256): RSA templates exist so benchmarks can measure the
+    # realistic row-bytes regime, not just the friendly one.
+    if key_type == "rsa2048":
+        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    elif key_type == "ec":
+        key = ec.generate_private_key(ec.SECP256R1())
+    else:
+        raise ValueError(f"unknown key_type {key_type!r} (ec | rsa2048)")
     issuer_name = x509.Name(
         [
             x509.NameAttribute(NameOID.COUNTRY_NAME, "US"),
@@ -71,9 +82,9 @@ def _build_pair(
         serialization.Encoding.DER
     )
 
-    # Template serial: SERIAL_LEN bytes, first byte 0x4D (positive, no
+    # Template serial: serial_len bytes, first byte 0x4D (positive, no
     # leading-zero trimming) so every restamp keeps identical DER shape.
-    serial_int = int.from_bytes(b"\x4d" + b"\x00" * (SERIAL_LEN - 1), "big")
+    serial_int = int.from_bytes(b"\x4d" + b"\x00" * (serial_len - 1), "big")
     leaf_builder = (
         x509.CertificateBuilder()
         .subject_name(
@@ -100,6 +111,80 @@ def _build_pair(
             ),
             critical=False,
         )
+    if rich_extensions:
+        # The production extension load (SAN, AIA, KU, EKU, SKI, AKI)
+        # that puts real leaf certs in the 1.2-1.9 KB regime — the
+        # walker's extension scan must be benchmarked against this
+        # shape, not just the minimal template.
+        leaf_builder = (
+            leaf_builder
+            .add_extension(
+                x509.SubjectAlternativeName([
+                    x509.DNSName("bench.example.com"),
+                    x509.DNSName("www.bench.example.com"),
+                    x509.DNSName("cdn.bench.example.com"),
+                ]),
+                critical=False,
+            )
+            .add_extension(
+                x509.AuthorityInformationAccess([
+                    x509.AccessDescription(
+                        x509.oid.AuthorityInformationAccessOID.OCSP,
+                        x509.UniformResourceIdentifier(
+                            "http://ocsp.bench.example"),
+                    ),
+                    x509.AccessDescription(
+                        x509.oid.AuthorityInformationAccessOID.CA_ISSUERS,
+                        x509.UniformResourceIdentifier(
+                            "http://ca.bench.example/issuer.crt"),
+                    ),
+                ]),
+                critical=False,
+            )
+            .add_extension(
+                x509.KeyUsage(
+                    digital_signature=True, key_encipherment=True,
+                    content_commitment=False, data_encipherment=False,
+                    key_agreement=False, key_cert_sign=False,
+                    crl_sign=False, encipher_only=False,
+                    decipher_only=False,
+                ),
+                critical=True,
+            )
+            .add_extension(
+                x509.ExtendedKeyUsage([
+                    x509.oid.ExtendedKeyUsageOID.SERVER_AUTH,
+                    x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH,
+                ]),
+                critical=False,
+            )
+            .add_extension(
+                x509.SubjectKeyIdentifier.from_public_key(key.public_key()),
+                critical=False,
+            )
+            .add_extension(
+                x509.AuthorityKeyIdentifier.from_issuer_public_key(
+                    key.public_key()),
+                critical=False,
+            )
+            .add_extension(
+                x509.CertificatePolicies([
+                    x509.PolicyInformation(
+                        x509.ObjectIdentifier("2.23.140.1.2.1"), None),
+                ]),
+                critical=False,
+            )
+            # Embedded SCT list stand-in (OID 1.3.6.1.4.1.11129.2.4.2):
+            # CT leaves carry ~120 B per SCT; two logs' worth of opaque
+            # bytes reproduces the real extension-scan workload.
+            .add_extension(
+                x509.UnrecognizedExtension(
+                    x509.ObjectIdentifier("1.3.6.1.4.1.11129.2.4.2"),
+                    bytes([0x04, 0xF6, 0x00, 0xF4]) + bytes(244),
+                ),
+                critical=False,
+            )
+        )
     leaf_der = leaf_builder.sign(key, hashes.SHA256()).public_bytes(
         serialization.Encoding.DER
     )
@@ -110,13 +195,22 @@ def make_template(
     issuer_cn: str = "Bench Issuer CA",
     not_after: datetime.datetime | None = None,
     crl_dp: str | None = "http://crl.bench.example/latest.crl",
+    key_type: str = "ec",
+    serial_len: int = SERIAL_LEN,
+    rich_extensions: bool = False,
 ) -> CertTemplate:
+    if not 8 <= serial_len <= 20:
+        # < 8 leaves no room for the epoch+lane counter fields the
+        # device stampers use; > 20 exceeds RFC 5280's serial bound.
+        raise ValueError(f"serial_len {serial_len} outside 8..20")
     not_after = not_after or datetime.datetime(
         2031, 6, 15, tzinfo=datetime.timezone.utc
     )
-    leaf_der, issuer_der = _build_pair(issuer_cn, not_after, crl_dp)
+    leaf_der, issuer_der = _build_pair(
+        issuer_cn, not_after, crl_dp, key_type=key_type,
+        serial_len=serial_len, rich_extensions=rich_extensions)
     fields = hostder.parse_cert(leaf_der)
-    assert fields.serial_len == SERIAL_LEN, fields.serial_len
+    assert fields.serial_len == serial_len, fields.serial_len
     return CertTemplate(
         leaf_der=leaf_der,
         issuer_der=issuer_der,
@@ -127,9 +221,10 @@ def make_template(
 
 def stamp_serial(template: CertTemplate, counter: int) -> bytes:
     """One DER variant: template with serial content = 0x4D ‖ counter."""
-    body = counter.to_bytes(SERIAL_LEN - 1, "big")
+    n = template.serial_len
+    body = counter.to_bytes(n - 1, "big")
     der = bytearray(template.leaf_der)
-    der[template.serial_off + 1 : template.serial_off + SERIAL_LEN] = body
+    der[template.serial_off + 1 : template.serial_off + n] = body
     return bytes(der)
 
 
@@ -153,10 +248,12 @@ def stamp_batch_array(
     data[:, : base.size] = base[None, :]
     counters = (np.arange(start, start + batch, dtype=np.uint64)
                 ^ np.uint64(rng_mix))
-    # big-endian expansion of the counter into the low 8 serial bytes
+    # big-endian expansion of the counter into the low serial bytes
+    # (8 of them, or serial_len - 1 for short serials — byte 0 stays
+    # the fixed positive 0x4D either way)
     off = template.serial_off
-    for i in range(8):
-        data[:, off + SERIAL_LEN - 1 - i] = (
+    for i in range(min(8, template.serial_len - 1)):
+        data[:, off + template.serial_len - 1 - i] = (
             (counters >> np.uint64(8 * i)) & np.uint64(0xFF)
         ).astype(np.uint8)
     lengths = np.full((batch,), base.size, dtype=np.int32)
@@ -186,7 +283,13 @@ def build_device_batches(
     if base.size > pad_len:
         raise ValueError(f"template ({base.size}B) exceeds pad length {pad_len}")
     tlen = int(base.size)
-    lane_cols = template.serial_off + np.arange(12, 16, dtype=np.int32)
+    n = template.serial_len
+    if n < 12:
+        raise ValueError(
+            f"serial_len {n} < 12: the lane counter (last 4 bytes) would "
+            "collide with the epoch window (bytes 4..8); use the mixed "
+            "builder for short serials")
+    lane_cols = template.serial_off + np.arange(n - 4, n, dtype=np.int32)
 
     @jax.jit
     def build(base_row):
@@ -203,3 +306,102 @@ def build_device_batches(
     datas = build(jax.device_put(base))
     lens = jnp.full((n_batches, batch), tlen, dtype=jnp.int32)
     return datas, lens
+
+
+def zipf_weights(n: int, s: float = 1.1) -> np.ndarray:
+    """Zipf issuer split (CT reality: a handful of CAs dominate)."""
+    w = 1.0 / np.arange(1, n + 1, dtype=np.float64) ** s
+    return w / w.sum()
+
+
+@dataclass
+class MixedBatchSet:
+    """Device-resident mixed-template batches + the per-lane stamping
+    metadata benchmark steps need."""
+
+    datas: "object"  # uint8[G, B, pad] device array
+    lens: "object"  # int32[G, B] device array
+    issuer_idx: np.ndarray  # int32[B] — registry index per lane
+    epoch_cols: np.ndarray  # int32[B, 3] — serial bytes 1..4 per lane
+    template_of: np.ndarray  # int32[B]
+    templates: list  # list[CertTemplate]
+
+
+def build_mixed_device_batches(
+    templates: list[CertTemplate],
+    weights: np.ndarray,
+    n_batches: int,
+    batch: int,
+    pad_len: int,
+    seed: int = 0,
+) -> MixedBatchSet:
+    """Resident batches mixing several templates (issuers, key types,
+    serial lengths) in one device batch — the realistic-mix benchmark
+    shape (real CT streams interleave RSA/ECDSA certs of many CAs,
+    /root/reference/cmd/ct-fetch/ct-fetch.go:416-424).
+
+    Stamping schema, uniform across serial lengths 8..20: serial
+    content byte 0 stays the template's positive 0x4D; bytes 1..4 are
+    the per-sweep epoch window (24 bits, restamped on device by the
+    bench step via ``epoch_cols``); the LAST 4 bytes are the lane
+    counter. Disjoint for every length >= 8.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    t_count = len(templates)
+    if t_count < 1:
+        raise ValueError("need at least one template")
+    w = np.asarray(weights, np.float64)
+    w = w / w.sum()
+    template_of = rng.choice(t_count, size=batch, p=w).astype(np.int32)
+
+    tpl_rows = np.zeros((t_count, pad_len), np.uint8)
+    tpl_lens = np.zeros((t_count,), np.int32)
+    ser_off = np.zeros((t_count,), np.int32)
+    ser_len = np.zeros((t_count,), np.int32)
+    for i, t in enumerate(templates):
+        raw = np.frombuffer(t.leaf_der, dtype=np.uint8)
+        if raw.size > pad_len:
+            raise ValueError(
+                f"template {i} ({raw.size}B) exceeds pad length {pad_len}")
+        tpl_rows[i, : raw.size] = raw
+        tpl_lens[i] = raw.size
+        ser_off[i] = t.serial_off
+        ser_len[i] = t.serial_len
+
+    off_of = ser_off[template_of]  # int32[B]
+    lane_cols = (off_of[:, None] + ser_len[template_of][:, None] - 4
+                 + np.arange(4, dtype=np.int32)[None, :])  # [B, 4]
+    epoch_cols = off_of[:, None] + np.arange(1, 4, dtype=np.int32)[None, :]
+
+    @jax.jit
+    def build(tpl_rows, template_of, lane_cols):
+        data = tpl_rows[template_of]  # [B, pad] gather
+        data = jnp.broadcast_to(data, (n_batches,) + data.shape)
+        cnt = (jnp.arange(n_batches, dtype=jnp.uint32)[:, None] * batch
+               + jnp.arange(batch, dtype=jnp.uint32)[None, :])
+        cb = jnp.stack(
+            [(cnt >> 24) & 0xFF, (cnt >> 16) & 0xFF,
+             (cnt >> 8) & 0xFF, cnt & 0xFF], axis=-1
+        ).astype(jnp.uint8)  # [G, B, 4]
+        rows_ix = jnp.arange(batch, dtype=jnp.int32)[None, :, None]
+        return data.at[
+            jnp.arange(n_batches, dtype=jnp.int32)[:, None, None],
+            rows_ix, lane_cols[None, :, :],
+        ].set(cb)
+
+    datas = build(jax.device_put(tpl_rows), jax.device_put(template_of),
+                  jax.device_put(lane_cols))
+    lens = jnp.broadcast_to(
+        jnp.asarray(tpl_lens[template_of], dtype=jnp.int32)[None, :],
+        (n_batches, batch))
+    return MixedBatchSet(
+        datas=datas,
+        lens=lens,
+        issuer_idx=template_of.copy(),
+        epoch_cols=epoch_cols.astype(np.int32),
+        template_of=template_of,
+        templates=list(templates),
+    )

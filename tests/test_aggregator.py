@@ -319,3 +319,44 @@ def test_rsa_certificates_device_path():
     assert snap.issuers() == [Issuer.from_spki(spki).id()]
     # Serial bytes: raw DER integer encoding (leading 0x7f, 20 bytes).
     assert res.serials[0] == (b"\x7f" + b"\xab" * 19)
+
+
+def test_registry_overflow_routes_to_host_lane():
+    # A full-log replay can exceed META_ISSUER_BITS worth of issuers;
+    # issuers past the device meta range must degrade to the exact
+    # host lane (count-exact), not crash ingest.
+    a = agg()
+    reg = a.registry
+    while len(reg._issuers) < packing.MAX_ISSUERS:
+        iss = Issuer.from_string(f"pad-{len(reg._issuers)}")
+        reg._by_issuer_id[iss.id()] = len(reg._issuers)
+        reg._issuers.append(iss)
+
+    cas = [make_cert(issuer_cn=f"Ovf CA {i}", key_seed=60 + i)
+           for i in range(2)]
+    entries = []
+    for i, ca in enumerate(cas):
+        for s in range(3):
+            entries.append(
+                (leaf(77000 + 10 * i + s, issuer_cn=f"Ovf CA {i}"), ca))
+    res = a.ingest(entries)
+    assert (res.issuer_idx >= packing.MAX_ISSUERS).all()
+    assert res.was_unknown.all()
+    assert not res.filtered.any()
+    assert res.host_lane_count == len(entries)  # all took the exact lane
+
+    # Re-ingest dedups exactly; totals stay put.
+    res2 = a.ingest(entries)
+    assert not res2.was_unknown.any()
+
+    snap = a.drain()
+    assert snap.total == len(entries)
+    per_issuer = {}
+    for (iss_id, _), c in snap.counts.items():
+        per_issuer[iss_id] = per_issuer.get(iss_id, 0) + c
+    for ca in cas:
+        iid = Issuer.from_spki(spki_of(ca)).id()
+        assert per_issuer[iid] == 3
+        idx = reg.index_of_issuer_id(iid)
+        assert idx >= packing.MAX_ISSUERS
+        assert a.issuer_totals[idx] == 3

@@ -68,3 +68,71 @@ def test_checkpoint_layout_survives_env_change(monkeypatch, writer, reader):
         assert b.drain().total == 210
     finally:
         os.unlink(path)
+
+
+@pytest.mark.parametrize("layout", ["bucket", "open"])
+def test_checkpoint_topology_mismatch_rehashes(monkeypatch, layout):
+    # A multi-shard writer records positions in shard-local addressing
+    # (dest * nb_local + local hash); a single-chip reader must re-hash
+    # every row instead of trusting positions, or its own hashes can't
+    # reach them and dedup double-counts.
+    import jax
+    from jax.sharding import Mesh
+
+    from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
+
+    monkeypatch.setenv("CTMR_TABLE", layout)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shard",))
+    a = ShardedAggregator(mesh, capacity=1 << 12, batch_size=64, now=NOW)
+    ents = entries(150, f"Topo CA {layout}")
+    res = a.ingest(ents)
+    assert res.was_unknown.all()
+    fd, path = tempfile.mkstemp(suffix=".npz")
+    os.close(fd)
+    try:
+        a.save_checkpoint(path)
+        z = np.load(path, allow_pickle=True)
+        assert int(z["n_shards"]) == 8
+
+        b = TpuAggregator(capacity=1 << 10, batch_size=64, now=NOW)
+        b.load_checkpoint(path)
+        res2 = b.ingest(ents)  # everything already known — no recount
+        assert not res2.was_unknown.any()
+        more = entries(60, f"Topo CA {layout} 2", base=9000)
+        assert b.ingest(more).was_unknown.all()
+        assert b.drain().total == 210
+
+        # And back: the sharded reader re-hashes any snapshot.
+        c = ShardedAggregator(mesh, capacity=1 << 12, batch_size=64, now=NOW)
+        c.load_checkpoint(path)
+        assert not c.ingest(ents).was_unknown.any()
+        assert c.drain().total == 150
+    finally:
+        os.unlink(path)
+
+
+def test_host_snapshot_reads_sharded_bucket_checkpoint(monkeypatch):
+    # storage-statistics --backend=tpu must be able to report on a
+    # snapshot written by a mesh writer WITHOUT claiming the device:
+    # the host reader re-hashes through the NumPy bulk insert.
+    import jax
+    from jax.sharding import Mesh
+
+    from ct_mapreduce_tpu.agg.aggregator import HostSnapshotAggregator
+    from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
+
+    monkeypatch.setenv("CTMR_TABLE", "bucket")
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shard",))
+    a = ShardedAggregator(mesh, capacity=1 << 12, batch_size=64, now=NOW)
+    ents = entries(120, "HostSnap CA")
+    assert a.ingest(ents).was_unknown.all()
+    fd, path = tempfile.mkstemp(suffix=".npz")
+    os.close(fd)
+    try:
+        a.save_checkpoint(path)
+        h = HostSnapshotAggregator(capacity=1 << 10, batch_size=64, now=NOW)
+        h.load_checkpoint(path)
+        assert isinstance(h.table.rows, np.ndarray)
+        assert h.drain().total == 120
+    finally:
+        os.unlink(path)

@@ -1,0 +1,155 @@
+"""Sharded-step overhead on real hardware, mesh=1 (VERDICT r04 #2).
+
+ARCHITECTURE.md's scale-out projection assumed the routing stages
+(fixed-cap dispatch, all_to_all, shard-local insert, inverse route,
+psum) cost little; until round 5 that had only ever run on virtual CPU
+meshes. This probe times the FULL sharded step (shard_map over a
+1-device mesh — all_to_all degenerates to a copy but every routing
+stage still executes) under the trusted contract (jitted fori_loop
+sweeps, synchronous value read), on the same resident batches as the
+plain fused step, so
+
+    overhead = sharded ns/entry  -  plain ns/entry   (same data)
+
+is a measured number. Run both on one chip:
+
+    python tools/shardcost.py [batch] [log2_cap]     # sharded step
+    python tools/stagecost.py [batch] full           # plain step
+
+Env: CT_SC_EXEC_SECS, CT_SC_PADLEN, CTMR_TABLE (bucket default).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def say(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from ct_mapreduce_tpu.agg import sharded
+    from ct_mapreduce_tpu.core import packing
+    from ct_mapreduce_tpu.ops import pipeline
+    from ct_mapreduce_tpu.utils import syncerts
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    log2_cap = int(sys.argv[2]) if len(sys.argv) > 2 else 26
+    cap_slots = 1 << log2_cap
+    pad_len = int(os.environ.get("CT_SC_PADLEN", "1024"))
+    exec_target_s = float(os.environ.get("CT_SC_EXEC_SECS", "4.0"))
+
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    say(f"device: {dev.platform} ({dev.device_kind}) acquired in "
+        f"{time.perf_counter() - t0:.1f}s; batch={batch} cap=2^{log2_cap} "
+        f"mesh=1")
+
+    mesh = Mesh(np.array(jax.devices()[:1]), (sharded.AXIS,))
+    dedup = sharded.ShardedDedup(
+        mesh, capacity=sharded.mesh_capacity(1, cap_slots))
+    n = dedup.n_shards
+    b_loc = batch // n
+    cap = min(b_loc, max(8, int(dedup.dispatch_factor * b_loc / n)))
+
+    tpl = syncerts.make_template()
+    datas, lens = syncerts.build_device_batches(tpl, 1, batch, pad_len)
+    row_sh = NamedSharding(mesh, P(sharded.AXIS))
+    issuer_idx = jax.device_put(np.zeros((batch,), np.int32), row_sh)
+    valid = jax.device_put(np.ones((batch,), bool), row_sh)
+    epoch_cols = tpl.serial_off + np.arange(4, 8, dtype=np.int32)
+    no_cn = jnp.zeros((0, 32), jnp.uint8)
+    no_cn_lens = jnp.zeros((0, 2), jnp.int32)
+    now_hour = 500_000
+
+    local = functools.partial(
+        sharded._local_step,
+        n_shards=n, cap=cap, num_issuers=dedup.num_issuers,
+        max_probes=dedup.max_probes, bucket=dedup.layout == "bucket",
+        axis=dedup.axis,
+    )
+    A = P(sharded.AXIS)
+    mapped = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(A, A, A, A, A, A, P(), P(), P(), P()),
+        out_specs=(
+            A, A,
+            sharded.ShardedStepOut(
+                was_unknown=A, host_lane=A, filtered_ca=A,
+                filtered_expired=A, filtered_cn=A, not_after_hour=A,
+                serials=A, serial_len=A, issuer_unknown_counts=P(),
+                has_crldp=A, crldp_off=A, crldp_len=A,
+                issuer_name_off=A, issuer_name_len=A,
+                probe_overflow=A, dispatch_dropped=A,
+            ),
+        ),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def mega(rows, count, acc, epoch_base, n_sweeps, datas, lens,
+             issuer_idx, valid):
+        def body(s, carry):
+            rows, count, acc = carry
+            e = (epoch_base + s).astype(jnp.uint32)
+            eb = jnp.stack(
+                [(e >> 24) & 0xFF, (e >> 16) & 0xFF, (e >> 8) & 0xFF,
+                 e & 0xFF]).astype(jnp.uint8)
+            data = datas[0].at[:, epoch_cols].set(eb[None, :])
+            rows, count, out = mapped(
+                rows, count, data, lens[0], issuer_idx, valid,
+                jnp.int32(now_hour), jnp.int32(packing.DEFAULT_BASE_HOUR),
+                no_cn, no_cn_lens)
+            return rows, count, (
+                acc + out.was_unknown.sum(dtype=jnp.int32)
+                + out.host_lane.sum(dtype=jnp.int32)
+                + out.dispatch_dropped.sum(dtype=jnp.int32))
+        return jax.lax.fori_loop(0, n_sweeps, body, (rows, count, acc))
+
+    fetch = jax.jit(lambda a: a + a.dtype.type(0))
+    acc = jax.device_put(np.int32(0))
+    rows, count = dedup.rows, dedup.count
+
+    t0 = time.perf_counter()
+    rows, count, acc = mega(rows, count, acc, np.uint32(0), np.int32(1),
+                            datas, lens, issuer_idx, valid)
+    int(fetch(acc))
+    say(f"compile+warmup: {time.perf_counter() - t0:.1f}s "
+        f"(dispatch cap={cap}/lane-pair)")
+    t0 = time.perf_counter()
+    rows, count, acc = mega(rows, count, acc, np.uint32(1), np.int32(1),
+                            datas, lens, issuer_idx, valid)
+    int(fetch(acc))
+    per_sweep = max(time.perf_counter() - t0, 1e-4)
+    budget = max(2, int(dedup.capacity * 0.45) // batch - 3)
+    nswp = max(2, min(int(exec_target_s / per_sweep), budget, 200))
+    t0 = time.perf_counter()
+    rows, count, acc = mega(rows, count, acc, np.uint32(2), np.int32(nswp),
+                            datas, lens, issuer_idx, valid)
+    int(fetch(acc))
+    dt = (time.perf_counter() - t0) / nswp
+    total = int(fetch(acc))
+    say(f"sharded {dt * 1e3:9.2f} ms/sweep  {dt / batch * 1e9:8.1f} "
+        f"ns/entry  ({nswp} sweeps; accounted={total} "
+        f"expect={(nswp + 2) * batch})")
+    if total != (nswp + 2) * batch:
+        say("WARNING: accounted lanes != stamped lanes")
+
+
+if __name__ == "__main__":
+    main()
