@@ -562,15 +562,16 @@ def run_e2e() -> dict:
     import base64
 
     from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
-    from ct_mapreduce_tpu.ingest import leaf as leaflib
     from ct_mapreduce_tpu.ingest.sync import AggregatorSink, RawBatch
     from ct_mapreduce_tpu.utils import syncerts
 
-    # 64K-lane dispatches: the tunneled stack charges ~0.2s of readback
-    # toll per device execution regardless of size, so fewer, larger
-    # steps raise the e2e ceiling 4x over 16K dispatches.
-    batch = int(os.environ.get("CT_BENCH_E2E_BATCH", "65536"))
-    n_batches = int(os.environ.get("CT_BENCH_E2E_BATCHES", "4"))
+    # 2^20-lane dispatches: the tunneled stack charges ~0.2s of
+    # readback toll per device execution regardless of size, so the
+    # e2e leg uses the same execution width the headline proves works
+    # (r04 ran 64K-lane dispatches here and paid the toll 16x more
+    # often — device_wait was ~50x the step's compute cost).
+    batch = int(os.environ.get("CT_BENCH_E2E_BATCH", "1048576"))
+    n_batches = int(os.environ.get("CT_BENCH_E2E_BATCHES", "2"))
     cn_batches = 1  # raw batches replayed through the CN-filter leg
     # The per-entry parity legs (host-exact + DatabaseSink→redis) cost
     # ~0.5 ms/entry in Python; cap their prefix so bigger device
@@ -582,20 +583,9 @@ def run_e2e() -> dict:
     tpls = [syncerts.make_template(issuer_cn=f"Bench Issuer {k}")
             for k in range(2)]
     t0 = time.perf_counter()
-    eds_cache = [
-        base64.b64encode(leaflib.encode_extra_data([t.issuer_der])).decode()
-        for t in tpls
-    ]
     raw_batches = []
     for i in range(n_batches):
-        lis, eds = [], []
-        for j in range(batch):
-            k = j & 1
-            der = syncerts.stamp_serial(tpls[k], i * batch + j)
-            lis.append(base64.b64encode(
-                leaflib.encode_leaf_input(der, 1_700_000_000_000 + j)
-            ).decode())
-            eds.append(eds_cache[k])
+        lis, eds = syncerts.make_wire_batch(tpls, i * batch, batch)
         raw_batches.append(RawBatch(lis, eds, i * batch, "bench-log"))
     log(f"e2e setup: {n_batches}x{batch} wire entries in "
         f"{time.perf_counter() - t0:.1f}s")
@@ -752,12 +742,21 @@ def run_e2e() -> dict:
     # BASELINE config #2's shape (issuerCNFilter, noop backend): replay
     # a prefix with the CN filter matching only issuer 0 — exactly that
     # half may land, the rest must be filtered ON DEVICE.
-    cn_agg = TpuAggregator(capacity=1 << 17, batch_size=batch,
+    # The CN leg ALWAYS recompiles: cn_prefixes is a traced uint8[P, K]
+    # input of the step, so P=0 -> P=1 changes the jit cache key no
+    # matter what capacity is. Keep the capacity equal anyway (same
+    # shape family) and, critically, charge the compile to the
+    # watchdog budget like every other compile in this file.
+    cn_agg = TpuAggregator(capacity=capacity, batch_size=batch,
                            cn_prefixes=("Bench Issuer 0",))
     cn_sink = AggregatorSink(cn_agg, flush_size=batch, device_queue_depth=2)
+    t0 = time.perf_counter()
     for rb in raw_batches[:cn_batches]:
         cn_sink.store_raw_batch(rb)
     cn_sink.flush()
+    cn_s = time.perf_counter() - t0
+    extend_watchdog(cn_s)
+    log(f"e2e CN leg (incl. P=1 recompile): {cn_s:.1f}s")
     cn_total = cn_agg.drain().total
     cn_want = cn_batches * ((batch + 1) // 2)
     cn_filtered = cn_agg.metrics["filtered_cn"]

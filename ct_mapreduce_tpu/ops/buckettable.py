@@ -19,9 +19,10 @@ nearly free. So:
   each row holding 24 slots x 5 words (4 fingerprint words + meta;
   word 120 caches the fill count, 121..127 spare) — one gather
   fetches a whole bucket, one scatter commits it, both tile-aligned.
-- Slots fill contiguously (0..fill-1); the count rides in the row's
-  spare word 120, so occupancy is one column read per round instead
-  of a 24-slot scan.
+- Slots fill contiguously (0..fill-1); the count ALSO rides in the
+  row's spare word 120 for `contains` and host-side restores. (The
+  insert still recomputes it by scanning — reading the cached word
+  instead measured 2x SLOWER; see the _FILL_MODE note below.)
 - Within-batch coordination is a SORT, not a scatter election: lanes
   sort by (bucket, key words, lane). Same-bucket lanes become
   adjacent, same-key lanes become adjacent-with-deterministic-first
@@ -98,6 +99,36 @@ def _window_from_env() -> int:
 
 #: New keys merged per bucket per round (adjacent-lane look-ahead).
 WINDOW = _window_from_env()
+
+#: Fill-count sourcing inside the insert round (perf bisect knob):
+#:   scan      — recompute via the 24-slot occupancy scan (default;
+#:               the fill word is still written, so the cache stays
+#:               valid for `contains` and host-side restores)
+#:   cache     — read row word FILL_WORD instead of scanning
+#:   scan-only — occupancy scan AND skip the fill-word write (the
+#:               exact round-4 program, for A/B timing)
+#:
+#: MEASURED (round 5, tools/insertcost.py at 2^20 lanes / cap 2^26 on
+#: one v5e): scan-only 65.8, scan 66.5, cache 133 ns/entry. Writing
+#: the cached count is free; READING it in place of the occupancy
+#: scan — the "obvious" win — DOUBLES insert cost (the single-column
+#: read replaces a reduce that XLA fused into the gather, and the
+#: resulting schedule materializes extra [B, 128] traffic). The scan
+#: stays the shipping formulation; the cache word exists for
+#: `contains`' emptiness test and topology-mismatched restores.
+def _fill_mode_from_env() -> str:
+    raw = os.environ.get("CTMR_FILL_MODE", "scan").strip().lower()
+    if raw not in ("scan", "cache", "scan-only"):
+        import warnings
+
+        warnings.warn(
+            f"ignoring CTMR_FILL_MODE={raw!r} "
+            "(want scan | cache | scan-only); using scan", stacklevel=2)
+        return "scan"
+    return raw
+
+
+_FILL_MODE = _fill_mode_from_env()
 
 
 class BucketTable(NamedTuple):
@@ -272,17 +303,23 @@ def insert(
         # 2^20 lanes for the stacked formulation of this very loop.
         row = rows[jnp.minimum(h, nb - 1)]  # [B, 128]
 
-        # Occupancy is the cached fill word (slots fill contiguously;
-        # the 24-iteration occupancy scan this replaces was pure
-        # formulation cost). The match scan still walks all 24 slots:
-        # empty slots are all-zero and keys are desentineled nonzero,
-        # so matching against them is harmless.
-        fill = row[:, FILL_WORD].astype(jnp.int32)
+        # Occupancy: the cached fill word, or the 24-slot scan
+        # (CTMR_FILL_MODE bisect knob). The match scan walks all 24
+        # slots either way: empty slots are all-zero and keys are
+        # desentineled nonzero, so matching against them is harmless.
+        scan_fill = jnp.zeros((b,), jnp.int32)
         in_row = jnp.zeros((b,), bool)
         for s in range(SLOTS):
             w = [row[:, s * 5 + i] for i in range(4)]
+            if _FILL_MODE != "cache":
+                occ_s = (w[0] | w[1] | w[2] | w[3]) != 0
+                scan_fill = scan_fill + occ_s.astype(jnp.int32)
             in_row = in_row | (
                 (w[0] == k0) & (w[1] == k1) & (w[2] == k2) & (w[3] == k3))
+        if _FILL_MODE == "cache":
+            fill = row[:, FILL_WORD].astype(jnp.int32)
+        else:
+            fill = scan_fill
         in_row = pend & in_row
 
         # Segment structure over the sorted order (dense scans only).
@@ -369,8 +406,9 @@ def insert(
         # The committed row also carries the updated fill count in its
         # spare word (all w_seg in-window new keys hold consecutive
         # ranks, so exactly min(w_seg, space) of them merge per round).
-        new_fill = (fill + jnp.minimum(w_seg, space)).astype(jnp.uint32)
-        outrow = jnp.where(col == FILL_WORD, new_fill[:, None], outrow)
+        if _FILL_MODE != "scan-only":
+            new_fill = (fill + jnp.minimum(w_seg, space)).astype(jnp.uint32)
+            outrow = jnp.where(col == FILL_WORD, new_fill[:, None], outrow)
 
         # One tile-aligned scatter per active bucket (heads hold
         # unique, sorted bucket ids — no duplicate indices).
@@ -444,12 +482,16 @@ def contains(state: BucketTable, keys: jax.Array,
         # minor dims pad to 128 lanes on TPU (layout rule in insert).
         # Emptiness comes from the cached fill word, not a slot scan.
         match = jnp.zeros((b,), bool)
+        has_empty = jnp.zeros((b,), bool)
         for s in range(SLOTS):
             w = [row[:, s * 5 + i] for i in range(4)]
             match = match | (
                 (w[0] == keys[:, 0]) & (w[1] == keys[:, 1])
                 & (w[2] == keys[:, 2]) & (w[3] == keys[:, 3]))
-        has_empty = row[:, FILL_WORD].astype(jnp.int32) < SLOTS
+            if _FILL_MODE == "scan-only":
+                has_empty = has_empty | ((w[0] | w[1] | w[2] | w[3]) == 0)
+        if _FILL_MODE != "scan-only":
+            has_empty = row[:, FILL_WORD].astype(jnp.int32) < SLOTS
         found = found | (open_ & match)
         open_ = open_ & ~match & ~has_empty
         h = jnp.where(open_, (h + 1) & (nb - 1), h)

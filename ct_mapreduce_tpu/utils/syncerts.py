@@ -308,6 +308,37 @@ def build_device_batches(
     return datas, lens
 
 
+def make_wire_batch(
+    templates: list[CertTemplate],
+    start: int,
+    n: int,
+    ts_base: int = 1_700_000_000_000,
+) -> tuple[list[str], list[str]]:
+    """One get-entries response worth of RFC 6962 wire entries
+    (base64 leaf_input / extra_data), entries alternating over
+    ``templates`` with serials ``start..start+n``. Shared by the e2e
+    benchmark leg and the decode-scaling probe so the two measure the
+    SAME stream format.
+    """
+    import base64
+
+    from ct_mapreduce_tpu.ingest import leaf as leaflib
+
+    eds_cache = [
+        base64.b64encode(
+            leaflib.encode_extra_data([t.issuer_der])).decode()
+        for t in templates
+    ]
+    lis, eds = [], []
+    for j in range(n):
+        k = j % len(templates)
+        der = stamp_serial(templates[k], start + j)
+        lis.append(base64.b64encode(
+            leaflib.encode_leaf_input(der, ts_base + j)).decode())
+        eds.append(eds_cache[k])
+    return lis, eds
+
+
 def zipf_weights(n: int, s: float = 1.1) -> np.ndarray:
     """Zipf issuer split (CT reality: a handful of CAs dominate)."""
     w = 1.0 / np.arange(1, n + 1, dtype=np.float64) ** s

@@ -1,0 +1,155 @@
+"""Compile-tax experiment: can jax.export serialization cut the cold
+start (VERDICT r04 #6)?
+
+The headline step compiles ~200s cold in EVERY process on the tunneled
+remote compiler, and jax's persistent compilation cache was measured
+SLOWER (306.8s vs 198.8s, r04). This probe measures the other standard
+route — ``jax.export`` StableHLO serialization: process A exports the
+insert-sweep program (the representative ~160s compile) to disk,
+process B deserializes and calls it, and both report time-to-first-
+result. If the remote compiler is the cost (as the cache result
+suggests), deserialization won't help either — but then the negative
+result is recorded with numbers, closing the VERDICT item honestly.
+
+  python tools/aotprobe.py save /tmp/ins.bin   # trace+compile+serialize
+  python tools/aotprobe.py load /tmp/ins.bin   # deserialize+first call
+  python tools/aotprobe.py cold                # baseline: plain compile
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+BATCH = 1 << 20
+CAP = 1 << 26
+
+
+def say(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _register(jexport) -> None:
+    from ct_mapreduce_tpu.ops import buckettable, hashtable
+
+    try:
+        jexport.register_namedtuple_serialization(
+            buckettable.BucketTable, serialized_name="ctmr.BucketTable")
+        jexport.register_namedtuple_serialization(
+            hashtable.TableState, serialized_name="ctmr.TableState")
+    except ValueError:
+        pass  # already registered in this process
+
+
+def build():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ct_mapreduce_tpu.ops import pipeline
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def mega(table, acc, epoch_base, n_sweeps, lane, meta, valid):
+        def keygen(e):
+            a = lane * jnp.uint32(0x9E3779B9) + e * jnp.uint32(0x85EBCA6B)
+            b = (a ^ (a >> 15)) * jnp.uint32(0xC2B2AE35)
+            c = (b ^ (b >> 13)) * jnp.uint32(0x27D4EB2F)
+            d = (c ^ (c >> 16)) * jnp.uint32(0x165667B1)
+            return jnp.stack([a ^ e, b, c, d], axis=1)
+
+        def body(s, carry):
+            table, acc = carry
+            keys = keygen((epoch_base + s).astype(jnp.uint32))
+            table, unknown, ovf = pipeline.table_insert(
+                table, keys, meta, valid)
+            return table, (acc + unknown.sum(dtype=jnp.int32)
+                           + ovf.sum(dtype=jnp.int32))
+
+        return jax.lax.fori_loop(0, n_sweeps, body, (table, acc))
+
+    return mega
+
+
+def args_for():
+    import jax
+
+    from ct_mapreduce_tpu.ops import buckettable
+
+    table = buckettable.make_table(CAP)
+    acc = jax.device_put(np.int32(0))
+    lane = jax.device_put(np.arange(BATCH, dtype=np.uint32))
+    meta = jax.device_put(np.zeros((BATCH,), np.uint32))
+    valid = jax.device_put(np.ones((BATCH,), bool))
+    return table, acc, np.uint32(0), np.int32(1), lane, meta, valid
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+    mode = sys.argv[1] if len(sys.argv) > 1 else "cold"
+    path = sys.argv[2] if len(sys.argv) > 2 else "/tmp/aot_insert.bin"
+
+    t_start = time.perf_counter()
+    dev = jax.devices()[0]
+    say(f"device: {dev.platform} ({dev.device_kind}) in "
+        f"{time.perf_counter() - t_start:.1f}s; mode={mode}")
+
+    fetch = jax.jit(lambda a: a + a.dtype.type(0))
+
+    if mode == "save":
+        from jax import export as jexport
+
+        from ct_mapreduce_tpu.ops import buckettable, hashtable
+
+        _register(jexport)
+        mega = build()
+        a = args_for()
+        t0 = time.perf_counter()
+        exp = jexport.export(mega)(*a)
+        t_trace = time.perf_counter() - t0
+        blob = exp.serialize()
+        with open(path, "wb") as fh:
+            fh.write(blob)
+        say(f"export+serialize: {t_trace:.1f}s, {len(blob)} bytes -> {path}")
+        # First real call from the exported artifact in THIS process.
+        t0 = time.perf_counter()
+        table, acc = exp.call(*a)
+        int(fetch(acc))
+        say(f"first call via export artifact: {time.perf_counter() - t0:.1f}s")
+    elif mode == "load":
+        from jax import export as jexport
+
+        from ct_mapreduce_tpu.ops import buckettable, hashtable
+
+        _register(jexport)
+        t0 = time.perf_counter()
+        with open(path, "rb") as fh:
+            exp = jexport.deserialize(fh.read())
+        t_de = time.perf_counter() - t0
+        a = args_for()
+        t0 = time.perf_counter()
+        table, acc = exp.call(*a)
+        int(fetch(acc))
+        t_first = time.perf_counter() - t0
+        say(f"deserialize: {t_de:.1f}s; first call (incl. any backend "
+            f"compile): {t_first:.1f}s; total-to-first-result "
+            f"{time.perf_counter() - t_start:.1f}s")
+    else:  # cold baseline
+        mega = build()
+        a = args_for()
+        t0 = time.perf_counter()
+        table, acc = mega(*a)
+        int(fetch(acc))
+        say(f"cold jit compile+first result: {time.perf_counter() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

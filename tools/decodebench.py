@@ -1,0 +1,52 @@
+"""Native-decoder throughput vs worker count (the e2e budget's host leg).
+
+The e2e ingest rate is host-decode-bound on single-core rigs (the
+native C++ decoder measures ~150-250k entries/s per core; a 5M/s chip
+needs tens of cores feeding it). This records the scaling table with
+the host otherwise QUIET — run it alone: concurrent device probes on
+the same host produced 5x scatter in earlier ad-hoc numbers.
+
+  python tools/decodebench.py [n_entries] [workers...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    from ct_mapreduce_tpu.native import leafpack
+    from ct_mapreduce_tpu.utils import syncerts
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 262144
+    workers = [int(w) for w in sys.argv[2:]] or [1, 2, 4, 0]
+    print(f"host: {os.cpu_count()} cpu(s); entries={n}", file=sys.stderr)
+
+    tpls = [syncerts.make_template(issuer_cn=f"Dec {k}") for k in range(2)]
+    t0 = time.time()
+    lis, edl = syncerts.make_wire_batch(tpls, 0, n)
+    print(f"wire setup {time.time() - t0:.1f}s", file=sys.stderr)
+
+    for w in workers:
+        best = None
+        for _ in range(3):  # best-of-3: scheduling noise on small hosts
+            t0 = time.time()
+            db = leafpack.decode_raw_batch(lis, edl, 1024,
+                                           workers=(w or None))
+            dt = time.time() - t0
+            assert int(db.ok_mask().sum()) == n
+            best = dt if best is None else min(best, dt)
+        print(json.dumps({
+            "workers": w or "auto",
+            "best_s": round(best, 3),
+            "entries_per_sec": round(n / best, 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

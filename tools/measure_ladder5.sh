@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-5 master ladder, VALUE-ORDERED (short pool windows decide the
+# round — highest-stakes numbers first):
+#   1. bench default — the headline + the NEW wide-dispatch e2e leg
+#   2. randacc walker-select experiments (oh_* vs dot_*: can the MXU
+#      take the walker's block selects?) + primitive prices refresh
+#   3. shardcost mesh=1 + stagecost full — the sharded-overhead delta
+#      (VERDICT #2's hardware number)
+#   4. bench CT_BENCH_MIX=rsa / mixed — the realistic-regime headline
+#      (VERDICT #3)
+#   5. CT_TPU_TESTS hardware tier (VERDICT #7)
+#   6. aotprobe cold/save/load — the compile-tax experiment (VERDICT #6)
+#   7. decodebench — host decode scaling, quiet host (VERDICT #4)
+# Never SIGTERM a mid-claim python process; kill by explicit PID only.
+#
+#   nohup tools/measure_ladder5.sh >/dev/null 2>&1 &
+#   tail -f /tmp/tpu_session5.log
+cd "$(dirname "$0")/.."
+log=${CT_LADDER5_LOG:-/tmp/tpu_session5.log}
+echo "=== ladder5 start $(date) ===" >> "$log"
+while true; do
+  python tools/probe_pool.py >> "$log" 2>&1
+  if [ $? -eq 0 ]; then break; fi
+  echo "--- still down $(date) ---" >> "$log"
+  sleep 45
+done
+echo "--- [1] bench default (headline + 2^20-lane e2e) ---" >> "$log"
+CT_BENCH_WATCHDOG_SECS=700 timeout 1800 python bench.py >> "$log" 2>&1
+echo "--- [2a] randacc walker-select experiments ---" >> "$log"
+timeout 1800 python tools/randacc.py 1048576 26 oh_pair dot_pair oh_sup dot_sup >> "$log" 2>&1
+echo "--- [2b] randacc primitive refresh ---" >> "$log"
+timeout 2400 python tools/randacc.py 1048576 26 g_row128 s_row128 sort4 >> "$log" 2>&1
+echo "--- [3a] shardcost mesh=1 2^20 ---" >> "$log"
+timeout 1800 python tools/shardcost.py 1048576 26 >> "$log" 2>&1
+echo "--- [3b] stagecost full 2^20 (plain-step reference) ---" >> "$log"
+timeout 1800 python tools/stagecost.py 1048576 lanes full >> "$log" 2>&1
+echo "--- [4a] bench rsa (pad 2048, rich extensions) ---" >> "$log"
+CT_BENCH_MIX=rsa CT_BENCH_E2E=0 CT_BENCH_WATCHDOG_SECS=700 \
+  timeout 1800 python bench.py >> "$log" 2>&1
+echo "--- [4b] bench mixed (16 issuers, Zipf, EC+RSA) ---" >> "$log"
+CT_BENCH_MIX=mixed CT_BENCH_E2E=0 CT_BENCH_WATCHDOG_SECS=700 \
+  timeout 1800 python bench.py >> "$log" 2>&1
+echo "--- [4c] bench pad ladder 1536 (ec template) ---" >> "$log"
+CT_BENCH_PADLEN=1536 CT_BENCH_E2E=0 CT_BENCH_WATCHDOG_SECS=700 \
+  timeout 1800 python bench.py >> "$log" 2>&1
+echo "--- [5] hardware test tier ---" >> "$log"
+CT_TPU_TESTS=1 timeout 2400 python -m pytest tests/test_tpu_hw.py -v >> "$log" 2>&1
+echo "--- [6a] aotprobe cold baseline ---" >> "$log"
+timeout 1200 python tools/aotprobe.py cold >> "$log" 2>&1
+echo "--- [6b] aotprobe save ---" >> "$log"
+timeout 1200 python tools/aotprobe.py save /tmp/aot_insert.bin >> "$log" 2>&1
+echo "--- [6c] aotprobe load (fresh process) ---" >> "$log"
+timeout 1200 python tools/aotprobe.py load /tmp/aot_insert.bin >> "$log" 2>&1
+echo "--- [7] decodebench (quiet host, no chip) ---" >> "$log"
+timeout 1800 python tools/decodebench.py 262144 1 2 4 0 >> "$log" 2>&1
+echo "=== ladder5 done $(date) ===" >> "$log"

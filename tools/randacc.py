@@ -117,7 +117,79 @@ def main() -> None:
             (h0, h1, h2, h3, lane.astype(jnp.int32)), num_keys=4)
         return (t1,), out[0][0] + out[4][0].astype(jnp.uint32)
 
+    # --- walker-select experiments: the DER walker's dominant cost is
+    # the dynamic-position block select over resident [B, 256]-word
+    # rows (der_kernel._window / _sup_fetch). Two formulations of the
+    # same fetch: the shipping VPU one-hot select-reduce, and an MXU
+    # int8 batched dot (one-hot as a 1x16 matrix; bytes are exact in
+    # int8 up to reinterpretation, fixable with a +128 bias if the dot
+    # wins). The walker is VPU-bound while the MXU idles, so a dot win
+    # here would offload the biggest parse term onto the idle unit.
+    mk_rows = lambda: jax.device_put(
+        np.arange(batch * 256, dtype=np.uint32).reshape(batch, 256))
+
+    def widx(seed):
+        h = (lane * np.uint32(0x9E3779B9)) ^ seed
+        return ((h * np.uint32(0x85EBCA6B)) % np.uint32(239)).astype(
+            jnp.int32)
+
+    def oh_pair(seed, tr):
+        base = widx(seed)
+        bi = base // 16
+        blk = tr.reshape(batch, 16, 16)
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (batch, 16), 1)
+        lo = jnp.sum(jnp.where((iota_k == bi[:, None])[:, :, None], blk,
+                               jnp.uint32(0)), axis=1)
+        hi = jnp.sum(jnp.where((iota_k == bi[:, None] + 1)[:, :, None], blk,
+                               jnp.uint32(0)), axis=1)
+        return (tr,), lo.sum() + hi.sum()
+
+    def dot_pair(seed, tr):
+        base = widx(seed)
+        bi = base // 16
+        blk8 = jax.lax.bitcast_convert_type(
+            tr.reshape(batch, 16, 16), jnp.uint8
+        ).reshape(batch, 16, 64).astype(jnp.int8)
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (batch, 16), 1)
+        oh = jnp.stack(
+            [(iota_k == bi[:, None]), (iota_k == bi[:, None] + 1)],
+            axis=1).astype(jnp.int8)  # [B, 2, 16]
+        pair = jnp.einsum("bmk,bkc->bmc", oh, blk8,
+                          preferred_element_type=jnp.int32)
+        return (tr,), pair.sum().astype(jnp.uint32)
+
+    def oh_sup(seed, tr):
+        # Clamp like the real _sup_fetch caller must: all 8 blocks
+        # (bi0..bi0+7) stay inside the 16-block row, so the probe
+        # times a realizable fetch on every lane.
+        bi0 = jnp.minimum(widx(seed) // 16, 8)
+        blk = tr.reshape(batch, 16, 16)
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (batch, 16), 1)
+        parts = [
+            jnp.sum(jnp.where((iota_k == bi0[:, None] + m)[:, :, None],
+                              blk, jnp.uint32(0)), axis=1)
+            for m in range(8)
+        ]
+        return (tr,), sum(p.sum() for p in parts)
+
+    def dot_sup(seed, tr):
+        bi0 = jnp.minimum(widx(seed) // 16, 8)  # see oh_sup
+        blk8 = jax.lax.bitcast_convert_type(
+            tr.reshape(batch, 16, 16), jnp.uint8
+        ).reshape(batch, 16, 64).astype(jnp.int8)
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (batch, 16), 1)
+        oh = jnp.stack(
+            [iota_k == bi0[:, None] + m for m in range(8)],
+            axis=1).astype(jnp.int8)  # [B, 8, 16]
+        sup = jnp.einsum("bmk,bkc->bmc", oh, blk8,
+                         preferred_element_type=jnp.int32)
+        return (tr,), sup.sum().astype(jnp.uint32)
+
     cases = {
+        "oh_pair": (oh_pair, (mk_rows,)),
+        "dot_pair": (dot_pair, (mk_rows,)),
+        "oh_sup": (oh_sup, (mk_rows,)),
+        "dot_sup": (dot_sup, (mk_rows,)),
         "g_scalar": (g_scalar, (mk_t1,)),
         "g_row5": (g_row5, (mk_t5,)),
         "g_row128": (g_row128, (mk_tb,)),
