@@ -239,11 +239,16 @@ def main() -> int:
 
     # NOTE on compiles: the bucket-table step costs ~180-200s to
     # compile cold on the tunneled remote compiler, every process
-    # (nothing caches across processes). jax's persistent compilation
-    # cache was tried and measured SLOWER here (306.8s vs 198.8s cold,
-    # 2026-07-31 — the chipless AOT path can't reuse the entries and
-    # pays serialization on top), so the budget protection is
-    # extend_watchdog(compile_s) below, not a cache.
+    # (nothing caches across processes). BOTH standard escapes were
+    # tried and measured useless here: jax's persistent compilation
+    # cache was SLOWER (306.8s vs 198.8s cold, 2026-07-31 — the
+    # chipless AOT path can't reuse the entries and pays serialization
+    # on top), and jax.export round-tripping is a wash
+    # (tools/aotprobe.py, docs/ladder_r05_run.log: export+serialize
+    # 0.5s/24KB — tracing is NOT the cost — deserialize 0.0s, but the
+    # first call still pays the REMOTE backend compile: 159.0s vs
+    # 169.2s cold). The compile lives server-side on this stack; the
+    # budget protection is extend_watchdog(compile_s), not a cache.
 
     from ct_mapreduce_tpu.core import packing
     from ct_mapreduce_tpu.agg.aggregator import _table_layout
