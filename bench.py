@@ -577,6 +577,12 @@ def run_e2e() -> dict:
     # often — device_wait was ~50x the step's compute cost).
     batch = int(os.environ.get("CT_BENCH_E2E_BATCH", "1048576"))
     n_batches = int(os.environ.get("CT_BENCH_E2E_BATCHES", "2"))
+    # Pipelining depth: 2 (overlap) measured FASTER than 0 even on the
+    # one-core host (32.5k vs 20.2k entries/s, docs/quiet_r05_run.log
+    # + the depth experiment) — the single-core decode-contention
+    # theory predicted the opposite and lost; synchronous ordering
+    # serializes the tunnel waits without freeing the decoder.
+    depth = int(os.environ.get("CT_BENCH_E2E_DEPTH", "2"))
     cn_batches = 1  # raw batches replayed through the CN-filter leg
     # The per-entry parity legs (host-exact + DatabaseSink→redis) cost
     # ~0.5 ms/entry in Python; cap their prefix so bigger device
@@ -604,7 +610,7 @@ def run_e2e() -> dict:
     t0 = time.perf_counter()
     warm_agg = TpuAggregator(capacity=capacity, batch_size=batch)
     warm_sink = AggregatorSink(warm_agg, flush_size=batch,
-                               device_queue_depth=2)
+                               device_queue_depth=depth)
     warm_sink.store_raw_batch(raw_batches[0])
     warm_sink.flush()
     e2e_compile_s = time.perf_counter() - t0
@@ -616,7 +622,7 @@ def run_e2e() -> dict:
     del warm_sink, warm_agg
 
     agg = TpuAggregator(capacity=capacity, batch_size=batch)
-    sink = AggregatorSink(agg, flush_size=batch, device_queue_depth=2)
+    sink = AggregatorSink(agg, flush_size=batch, device_queue_depth=depth)
     # Phase-budget capture: a private metrics sink records the sink's
     # decode/h2dSubmit/storeCertificate/completeBatch timers for JUST
     # the timed replay, so the JSON carries a breakdown proving where
@@ -754,7 +760,7 @@ def run_e2e() -> dict:
     # watchdog budget like every other compile in this file.
     cn_agg = TpuAggregator(capacity=capacity, batch_size=batch,
                            cn_prefixes=("Bench Issuer 0",))
-    cn_sink = AggregatorSink(cn_agg, flush_size=batch, device_queue_depth=2)
+    cn_sink = AggregatorSink(cn_agg, flush_size=batch, device_queue_depth=depth)
     t0 = time.perf_counter()
     for rb in raw_batches[:cn_batches]:
         cn_sink.store_raw_batch(rb)

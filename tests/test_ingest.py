@@ -600,3 +600,73 @@ def test_sync_contention_stress_exact_totals():
     for k in range(4):
         st = db.get_log_state(f"ct.example.com/race{k}")
         assert st.max_entry == 17
+
+
+def test_raw_batch_narrow_decode_and_redecode():
+    """The raw path picks the narrow row width BEFORE decoding when
+    every leaf_input provably fits (base64 length bound), and
+    redecodes at full width when a precert-style entry turns out
+    TOO_LONG for the narrow rows — counts exact either way."""
+    import base64
+
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.ingest import leaf as leaflib
+    from ct_mapreduce_tpu.ingest.sync import RawBatch
+    from ct_mapreduce_tpu.native import leafpack
+
+    issuer_der = certgen.make_cert(serial=1, issuer_cn="Nar CA",
+                                   is_ca=True, not_after=FUTURE)
+    small = [certgen.make_cert(serial=50 + i, issuer_cn="Nar CA",
+                               subject_cn=f"n{i}.example.com",
+                               is_ca=False, not_after=FUTURE)
+             for i in range(4)]
+    ed = base64.b64encode(leaflib.encode_extra_data([issuer_der])).decode()
+
+    pads_seen = []
+    orig = leafpack.decode_raw_batch
+
+    def spy(lis, eds, pad_len, workers=None):
+        pads_seen.append(pad_len)
+        return orig(lis, eds, pad_len, workers=workers)
+
+
+    # (a) all-small batch: ONE decode at the narrow width.
+    agg = TpuAggregator(capacity=1 << 12, batch_size=64,
+                        now=datetime.datetime(2025, 1, 1, tzinfo=UTC))
+    sink = AggregatorSink(agg, flush_size=64)
+    lis = [base64.b64encode(
+        leaflib.encode_leaf_input(der, i)).decode()
+        for i, der in enumerate(small)]
+    leafpack.decode_raw_batch = spy
+    try:
+        sink.store_raw_batch(RawBatch(lis, [ed] * len(lis), 0, "log"))
+        sink.flush()
+        assert pads_seen == [sink.PAD_LEN // 2]
+        assert agg.drain().total == len(small)
+
+        # (b) a precert whose cert rides in extra_data and exceeds the
+        # narrow width: leaf_input stays tiny (the bound can't see it),
+        # the narrow decode flags TOO_LONG, and ONE full-width
+        # redecode lands everything exactly.
+        pads_seen.clear()
+        big = certgen.make_cert(
+            serial=77, issuer_cn="Nar CA", subject_cn="pc.example.com",
+            is_ca=False, not_after=FUTURE,
+            extra_extensions=30, extra_ext_size=40)
+        assert sink.PAD_LEN // 2 < len(big) <= sink.PAD_LEN, len(big)
+        pre_li = base64.b64encode(leaflib.encode_leaf_input(
+            b"\x00" * 10, 7,
+            entry_type=leaflib.PRECERT_ENTRY)).decode()
+        pre_ed = base64.b64encode(leaflib.encode_extra_data(
+            [issuer_der], entry_type=leaflib.PRECERT_ENTRY,
+            pre_certificate=big)).decode()
+        agg2 = TpuAggregator(capacity=1 << 12, batch_size=64,
+                             now=datetime.datetime(2025, 1, 1, tzinfo=UTC))
+        sink2 = AggregatorSink(agg2, flush_size=64)
+        sink2.store_raw_batch(RawBatch(
+            lis + [pre_li], [ed] * len(lis) + [pre_ed], 0, "log"))
+        sink2.flush()
+        assert pads_seen == [sink2.PAD_LEN // 2, sink2.PAD_LEN]
+        assert agg2.drain().total == len(small) + 1
+    finally:
+        leafpack.decode_raw_batch = orig
