@@ -591,8 +591,25 @@ def run_e2e() -> dict:
 
     # Two issuers (BASELINE config #3's multi-issuer shape): entries
     # alternate, so the parity check covers per-issuer attribution too.
-    tpls = [syncerts.make_template(issuer_cn=f"Bench Issuer {k}")
-            for k in range(2)]
+    # CT_BENCH_E2E_MIX=1 replays a realistic wire stream instead of the
+    # minimal-ECDSA one: alternating rich-extension RSA-2048 and EC
+    # leaves (the li length bound exceeds the narrow row width, so the
+    # full 2048-wide decode+H2D path is the one measured — the same
+    # regime CT_BENCH_MIX=rsa measures device-side).
+    e2e_mix = os.environ.get("CT_BENCH_E2E_MIX", "0") == "1"
+    if e2e_mix:
+        tpls = [
+            syncerts.make_template(issuer_cn="Bench Issuer 0",
+                                   key_type="rsa2048", serial_len=20,
+                                   rich_extensions=True),
+            syncerts.make_template(issuer_cn="Bench Issuer 1",
+                                   serial_len=16, rich_extensions=True),
+        ]
+        log(f"e2e mix: rsa {len(tpls[0].leaf_der)}B / "
+            f"ec {len(tpls[1].leaf_der)}B leaves")
+    else:
+        tpls = [syncerts.make_template(issuer_cn=f"Bench Issuer {k}")
+                for k in range(2)]
     t0 = time.perf_counter()
     raw_batches = []
     for i in range(n_batches):
@@ -796,6 +813,7 @@ def run_e2e() -> dict:
     return {
         "e2e_entries_per_sec": round(rate, 1),
         "e2e_entries": total,
+        **({"e2e_mix": 1} if e2e_mix else {}),
         **budget,
     }
 
