@@ -157,30 +157,48 @@ class PendingIngest:
         self._data = data
         self._length = length
         self._done = False
+        # Overlapped ingest completes pendings from a drain consumer
+        # thread while checkpoint/grow paths may concurrently call
+        # complete_outstanding from the submit thread; the per-pending
+        # lock makes the race a cheap no-op for whoever loses it.
+        self._lock = threading.Lock()
 
     def complete(self) -> IngestResult:
-        if self._done:
+        with self._lock:
+            if self._done:
+                return self._res
+            # Claimed BEFORE the fold (matching the pre-overlap
+            # semantics): a fold that raises must not be retried by a
+            # later completer — a partial fold re-applied would
+            # double-count.
+            self._done = True
+            agg = self._agg
+            # All host-state fold-ins serialize on the aggregator-wide
+            # fold lock (metrics, issuer_totals, host_serials, and the
+            # cross-encoding guard are shared mutable state). FIFO order
+            # is preserved because every completer — the drain consumer
+            # and complete_outstanding alike — takes the OLDEST pending
+            # first and blocks on its per-pending lock.
+            with agg._fold_lock:
+                with contextlib.suppress(ValueError):
+                    agg._outstanding.remove(self)
+                agg._inflight_lanes = max(
+                    0, agg._inflight_lanes - len(self._res.was_unknown))
+                res = self._res
+                host_lane_total = 0
+                for batch, device_pos, lane_of, out in self._chunks:
+                    host_pos = agg._consume_out(batch, out, device_pos, res,
+                                                lane_of, host_rows=self._data)
+                    host_lane_total += agg._host_lanes(
+                        host_pos,
+                        lambda pos: self._data[
+                            pos, : self._length[pos]].tobytes(),
+                        res,
+                    )
+                agg.metrics["host_lane"] += host_lane_total
+                res.host_lane_count = host_lane_total
+                incr_counter("aggregator", "batches")
             return self._res
-        self._done = True
-        agg = self._agg
-        with contextlib.suppress(ValueError):
-            agg._outstanding.remove(self)
-        agg._inflight_lanes = max(
-            0, agg._inflight_lanes - len(self._res.was_unknown))
-        res = self._res
-        host_lane_total = 0
-        for batch, device_pos, lane_of, out in self._chunks:
-            host_pos = agg._consume_out(batch, out, device_pos, res, lane_of,
-                                        host_rows=self._data)
-            host_lane_total += agg._host_lanes(
-                host_pos,
-                lambda pos: self._data[pos, : self._length[pos]].tobytes(),
-                res,
-            )
-        agg.metrics["host_lane"] += host_lane_total
-        res.host_lane_count = host_lane_total
-        incr_counter("aggregator", "batches")
-        return res
 
 
 @dataclass
@@ -278,15 +296,27 @@ class TpuAggregator:
         grow_at: float = 0.55,
         max_capacity: int = 1 << 28,
     ) -> None:
-        if max_capacity & (max_capacity - 1):
-            # Growth targets double from a power-of-two capacity; a
-            # ragged ceiling would make grow() raise on every ingest
-            # once tripped. Round DOWN so the ceiling stays honest.
-            max_capacity = 1 << (max_capacity.bit_length() - 1)
-        # Set before the table exists: _make_table clamps the bucket
-        # layout's power-of-two round-up to this ceiling (rows are
-        # 512 B/bucket; a silent 2x overshoot would double HBM use).
-        self.max_capacity = max_capacity
+        # ADVICE r05 grow-livelock fix: round the ceiling DOWN to a
+        # capacity the active layout can actually build — bucket
+        # layouts only reach 24·2^k slots, open layouts powers of two;
+        # neither ever reaches a ragged 2^m+r ceiling — so maybe_grow's
+        # at-ceiling guard can fire. Without this, a table at the
+        # clamped bucket capacity saw capacity < max_capacity forever
+        # and re-ran a full drain+rebuild+reinsert on every batch past
+        # the threshold — gaining zero slots each time. Set before the
+        # table exists: _make_table clamps its round-up to this ceiling
+        # (rows are 512 B/bucket; a silent 2x overshoot would double
+        # HBM use).
+        self.max_capacity = self._layout_capacity_floor(max_capacity)
+        # Serializes host-state fold-ins (PendingIngest.complete /
+        # _consume_out / _host_lanes) across threads — the overlapped
+        # ingest path completes from a drain consumer thread.
+        self._fold_lock = threading.Lock()
+        # Guards self.table swaps vs concurrent reads: the donated step
+        # invalidates the previous table buffer, so a contains probe or
+        # checkpoint read racing a submit would touch a deleted array.
+        # Lock order where both are held: _fold_lock, then _table_lock.
+        self._table_lock = threading.RLock()
         self.table = self._make_table(capacity)
         # Bucket tables round capacity up to whole buckets; load-factor
         # arithmetic must use the real slot count.
@@ -340,6 +370,20 @@ class TpuAggregator:
         }
 
     # -- state hooks (overridden by the mesh-sharded subclass) -----------
+    def _layout_capacity_floor(self, cap: int) -> int:
+        """Largest capacity ≤ ``cap`` the active layout can build.
+
+        Bucket tables hold 24·2^k slots; open-addressed tables any
+        power of two (growth doubles from either, so a floored ceiling
+        stays exactly reachable). The growth ceiling is rounded THROUGH
+        this at construction so ``capacity >= max_capacity`` is
+        reachable and the at-ceiling guard can fire."""
+        if _table_layout() == "bucket":
+            return buckettable.bucket_count(cap, cap) * buckettable.SLOTS
+        if cap & (cap - 1):
+            cap = 1 << (cap.bit_length() - 1)
+        return cap
+
     def _make_table(self, capacity: int):
         if _table_layout() == "bucket":
             return buckettable.make_table(
@@ -362,23 +406,29 @@ class TpuAggregator:
         return hashtable.drain_np(self.table)
 
     def _device_contains(self, fps: np.ndarray) -> np.ndarray:
-        """bool[n]: are these fingerprints present in the device table?"""
+        """bool[n]: are these fingerprints present in the device table?
+
+        Dispatch AND materialization run under the table lock: the
+        donated step invalidates the previous table buffer, so a probe
+        racing a concurrent submit could read a deleted array."""
         import jax.numpy as jnp
 
-        if isinstance(self.table, buckettable.BucketTable):
+        with self._table_lock:
+            if isinstance(self.table, buckettable.BucketTable):
+                return np.asarray(
+                    buckettable.contains(self.table, jnp.asarray(fps),
+                                         max_probes=self.max_probes),
+                )
             return np.asarray(
-                buckettable.contains(self.table, jnp.asarray(fps),
-                                     max_probes=self.max_probes),
+                hashtable.contains(self.table, jnp.asarray(fps),
+                                   max_probes=self.max_probes),
             )
-        return np.asarray(
-            hashtable.contains(self.table, jnp.asarray(fps),
-                               max_probes=self.max_probes),
-        )
 
     # -- load-factor policy ---------------------------------------------
     def _table_fill_exact(self) -> int:
         """Occupied-slot count, synced from the device."""
-        return int(np.asarray(self.table.count))
+        with self._table_lock:
+            return int(np.asarray(self.table.count))
 
     def _rebuild_table(self, new_capacity: int) -> int:
         """Fresh empty table at ``new_capacity``; returns the actual
@@ -457,24 +507,29 @@ class TpuAggregator:
         keeps exact counts either way."""
         self.complete_outstanding()
         t0 = time.perf_counter()
-        keys, meta = self._drain_table()
-        old_capacity = self.capacity
-        saved = self._save_table_state()
-        cap = new_capacity
-        while True:
-            actual = self._rebuild_table(cap)
-            overflow = self._bulk_reinsert(keys, meta)
-            if not overflow:
-                break
-            if cap >= self.max_capacity:
-                self._restore_table_state(saved)
-                raise RuntimeError(
-                    f"table grow overflowed {overflow} rows even at the "
-                    f"max capacity {cap}; original table restored "
-                    "(pathological key distribution)"
-                )
-            cap = min(cap * 2, self.max_capacity)
-        self.capacity = actual
+        # Table lock taken only AFTER the completes above: a drain
+        # consumer mid-complete holds the fold lock and may probe the
+        # table, so grabbing the table lock first would deadlock
+        # (fold → table is the global order).
+        with self._table_lock:
+            keys, meta = self._drain_table()
+            old_capacity = self.capacity
+            saved = self._save_table_state()
+            cap = new_capacity
+            while True:
+                actual = self._rebuild_table(cap)
+                overflow = self._bulk_reinsert(keys, meta)
+                if not overflow:
+                    break
+                if cap >= self.max_capacity:
+                    self._restore_table_state(saved)
+                    raise RuntimeError(
+                        f"table grow overflowed {overflow} rows even at "
+                        f"the max capacity {cap}; original table restored "
+                        "(pathological key distribution)"
+                    )
+                cap = min(cap * 2, self.max_capacity)
+            self.capacity = actual
         self._table_fill = len(keys)
         incr_counter("aggregator", "table_grow")
         set_gauge("aggregator", "table_load",
@@ -537,13 +592,17 @@ class TpuAggregator:
                     host_pos.append(start + j)
             if device_entries:
                 self.maybe_grow(incoming=len(device_entries))
-                batch = packing.pack_entries(
-                    device_entries, batch_size=self.batch_size
+            # Fold lock taken AFTER maybe_grow: growth completes the
+            # outstanding pendings, whose folds need the same lock.
+            with self._fold_lock:
+                if device_entries:
+                    batch = packing.pack_entries(
+                        device_entries, batch_size=self.batch_size
+                    )
+                    host_pos += self._consume_chunk(batch, device_pos, res)
+                host_lane_total += self._host_lanes(
+                    host_pos, lambda pos: entries[pos][0], res
                 )
-                host_pos += self._consume_chunk(batch, device_pos, res)
-            host_lane_total += self._host_lanes(
-                host_pos, lambda pos: entries[pos][0], res
-            )
         self.metrics["host_lane"] += host_lane_total
         res.host_lane_count = host_lane_total
         incr_counter("aggregator", "batches")
@@ -638,9 +697,15 @@ class TpuAggregator:
     def complete_outstanding(self) -> None:
         """Fold every un-completed submit into host state (FIFO). Any
         reader of aggregate state (drain, checkpoint) calls this first
-        so pipelining can never lose in-flight results."""
-        while self._outstanding:
-            self._outstanding[0].complete()
+        so pipelining can never lose in-flight results. Robust to a
+        drain consumer thread completing (and removing) entries
+        concurrently — whoever loses the per-pending race no-ops."""
+        while True:
+            try:
+                pending = self._outstanding[0]
+            except IndexError:
+                return
+            pending.complete()
 
     def _consume_chunk(self, batch, device_pos, res, lane_of=None):
         """Run one packed chunk on device and fold the outputs into
@@ -803,18 +868,32 @@ class TpuAggregator:
 
     def _device_step_packed(self, batch):
         self._device_written = True
-        self.table, out = pipeline.ingest_step(
-            self.table,
-            batch.data,
-            batch.length,
-            batch.issuer_idx,
-            batch.valid,
-            np.int32(self._now_hour()),
-            np.int32(self.base_hour),
-            self._prefix_arr,
-            self._prefix_lens,
-            max_probes=self.max_probes,
-        )
+        import jax
+
+        # Device-resident rows (the overlapped/pipelined ingest path
+        # device_puts them ahead of the dispatch) are donated through
+        # the step — the caller keeps a host copy for host-lane slices,
+        # so the row buffer is dead weight after this dispatch and XLA
+        # may reuse its HBM. NumPy rows keep the non-donating wrapper,
+        # as does the CPU backend (its XLA can't alias this layout and
+        # warns on every dispatch).
+        step = (pipeline.ingest_step_donated
+                if isinstance(batch.data, jax.Array)
+                and jax.default_backend() != "cpu"
+                else pipeline.ingest_step)
+        with self._table_lock:
+            self.table, out = step(
+                self.table,
+                batch.data,
+                batch.length,
+                batch.issuer_idx,
+                batch.valid,
+                np.int32(self._now_hour()),
+                np.int32(self.base_hour),
+                self._prefix_arr,
+                self._prefix_lens,
+                max_probes=self.max_probes,
+            )
         return out
 
     def _accumulate_metadata_lanes(self, rows2d, row_sel, issuers,
@@ -987,7 +1066,8 @@ class TpuAggregator:
         the data storage-statistics prints
         (/root/reference/cmd/storage-statistics/storage-statistics.go:28-99)."""
         self.complete_outstanding()
-        _, meta = self._drain_table()
+        with self._table_lock:
+            _, meta = self._drain_table()
         counts: dict[tuple[str, str], int] = {}
         if meta.size:
             uniq, cnt = np.unique(meta, return_counts=True)
@@ -1074,7 +1154,8 @@ class TpuAggregator:
         # properties each pull rows through the tunnel (~0.5s per
         # 64 MB D2H), so going through them would double checkpoint
         # readback cost for multi-GB tables.
-        rows = np.asarray(self.table.rows)
+        with self._table_lock:
+            rows = np.asarray(self.table.rows)
         if layout == "bucket":
             slots = rows[:, : buckettable.SLOTS * 5].reshape(-1, 5)
         else:

@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ct_mapreduce_tpu.core import packing
 from ct_mapreduce_tpu.ops import buckettable, hashtable, pipeline
+from ct_mapreduce_tpu.utils.jax_compat import shard_map
 
 AXIS = "shard"
 
@@ -411,7 +412,7 @@ class ShardedDedup:
             axis=self.axis,
         )
         A = P(self.axis)
-        mapped = jax.shard_map(
+        mapped = shard_map(
             local,
             mesh=self.mesh,
             in_specs=(
@@ -487,7 +488,7 @@ class ShardedDedup:
                 jnp.sum(overflow, dtype=jnp.int32)[None],
             )
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             local,
             mesh=self.mesh,
             in_specs=tuple([P(self.axis)] * 5),

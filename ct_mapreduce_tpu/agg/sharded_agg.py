@@ -87,6 +87,21 @@ class ShardedAggregator(TpuAggregator):
         self.capacity = self.dedup.capacity
 
     # -- hooks -----------------------------------------------------------
+    def _layout_capacity_floor(self, cap: int) -> int:
+        """Largest mesh-buildable capacity ≤ ``cap``: shards get
+        power-of-two units, so halve the per-shard unit until the
+        mesh-rounded total fits under the configured ceiling."""
+        from ct_mapreduce_tpu.agg.sharded import mesh_capacity
+
+        n = self.mesh.devices.size
+        target = cap
+        while target >= n:
+            reach = mesh_capacity(n, target)
+            if reach <= cap:
+                return reach
+            target //= 2
+        return mesh_capacity(n, 1)
+
     def _make_table(self, capacity: int):
         return None  # state lives in self.dedup (sharded over the mesh)
 

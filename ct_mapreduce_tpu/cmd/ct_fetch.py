@@ -95,7 +95,8 @@ def build_sink(config: CTConfig, database, backend=None):
                               flush_size=config.batch_size,
                               backend=pem_backend,
                               device_queue_depth=config.device_queue_depth,
-                              decode_workers=config.decode_workers), model
+                              decode_workers=config.decode_workers,
+                              overlap_workers=config.overlap_workers), model
     sink = DatabaseSink(
         database,
         cn_filters=tuple(config.issuer_cn_filters()),

@@ -31,6 +31,7 @@ class CTConfig:
     log_url_list: str = ""  # "logList"
     num_threads: int = 1
     decode_workers: int = 0  # 0 = auto (cpu count); raw-batch decode pool
+    overlap_workers: int = 0  # >0 = overlapped ingest (decode‖device‖drain)
     log_expired_entries: bool = False
     run_forever: bool = False
     polling_delay_mean: str = "10m"
@@ -66,6 +67,7 @@ class CTConfig:
         "logList": ("log_url_list", str),
         "numThreads": ("num_threads", int),
         "decodeWorkers": ("decode_workers", int),
+        "overlapWorkers": ("overlap_workers", int),
         "logExpiredEntries": ("log_expired_entries", bool),
         "runForever": ("run_forever", bool),
         "pollingDelayMean": ("polling_delay_mean", str),
@@ -216,6 +218,7 @@ class CTConfig:
             "logExpiredEntries = Add expired entries to the database",
             "numThreads = Use this many threads for normal operations",
             "decodeWorkers = native leaf-decode threads (0 = cpu count)",
+            "overlapWorkers = overlapped-ingest decode pool size (0 = serial dispatch)",
             "savePeriod = Duration between state saves, e.g. 15m",
             "logList = URLs of the CT Logs, comma delimited",
             "outputRefreshPeriod = Period between output publications",

@@ -187,17 +187,27 @@ def parse_name(buf: bytes, off: int) -> tuple[list[list[NameAttribute]], int]:
         if set_tag != TAG_SET:
             raise DerError(f"RDN is not a SET (tag {set_tag:#x})")
         set_end = set_off + set_len
+        if set_end > end:
+            raise DerError("RDN SET overruns its Name")
         apos = set_off
         rdn: list[NameAttribute] = []
         while apos < set_end:
             seq_tag, seq_len, seq_off = read_tlv(buf, apos)
             if seq_tag != TAG_SEQUENCE:
                 raise DerError("AttributeTypeAndValue is not a SEQUENCE")
+            seq_end = seq_off + seq_len
+            if seq_end > set_end:
+                raise DerError("AttributeTypeAndValue overruns its RDN")
             oid_tag, oid_len, oid_off = read_tlv(buf, seq_off)
             if oid_tag != TAG_OID:
                 raise DerError("Attribute type is not an OID")
             oid = bytes(buf[oid_off : oid_off + oid_len])
             val_tag, val_len, val_off = read_tlv(buf, oid_off + oid_len)
+            if val_off + val_len > seq_end:
+                # A child escaping its parent TLV silently re-windows
+                # identity bytes (the CN window would disagree with the
+                # device walker's) — structurally invalid, reject.
+                raise DerError("attribute value overruns its ATV frame")
             raw = bytes(buf[val_off : val_off + val_len])
             try:
                 value = raw.decode("utf-8")
@@ -367,11 +377,17 @@ def _parse_crldp(buf: bytes, off: int) -> list[str]:
     return uris
 
 
-def _parse_basic_constraints(buf: bytes, off: int) -> bool:
+def _parse_basic_constraints(buf: bytes, off: int,
+                             end: int | None = None) -> bool:
     """BasicConstraints ::= SEQUENCE { cA BOOLEAN DEFAULT FALSE, ... }"""
     tag, length, content_off = read_tlv(buf, off)
     if tag != TAG_SEQUENCE or length == 0:
         return False
+    if end is not None and content_off + length > end:
+        # The inner SEQUENCE escaping its extnValue window would read
+        # the cA flag from bytes outside the extension (the device
+        # walker's windowed read rejects this) — invalid, reject.
+        raise DerError("BasicConstraints overruns its extnValue")
     b_tag, b_len, b_off = read_tlv(buf, content_off)
     return b_tag == TAG_BOOLEAN and b_len == 1 and buf[b_off] != 0x00
 
@@ -467,7 +483,8 @@ def parse_cert(der: bytes) -> CertFields:
                             if v_tag == TAG_OCTET_STRING:
                                 if oid == OID_BASIC_CONSTRAINTS:
                                     bc_valid = True
-                                    is_ca = _parse_basic_constraints(der, v_off)
+                                    is_ca = _parse_basic_constraints(
+                                        der, v_off, v_off + v_len)
                                 elif oid == OID_CRL_DISTRIBUTION_POINTS:
                                     crldps = _parse_crldp(der, v_off)
                     epos = e_off + e_len

@@ -13,6 +13,7 @@ layout mirrors §3.1-3.3 of SURVEY.md:
 
 from ct_mapreduce_tpu.ingest.ctclient import CTLogClient, SignedTreeHead, short_url
 from ct_mapreduce_tpu.ingest.leaf import DecodedEntry, decode_entry
+from ct_mapreduce_tpu.ingest.overlap import OverlapError, OverlapIngestPipeline
 from ct_mapreduce_tpu.ingest.sync import LogSyncEngine, LogWorker
 
 __all__ = [
@@ -23,4 +24,6 @@ __all__ = [
     "decode_entry",
     "LogSyncEngine",
     "LogWorker",
+    "OverlapError",
+    "OverlapIngestPipeline",
 ]

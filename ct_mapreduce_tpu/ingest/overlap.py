@@ -1,0 +1,241 @@
+"""Overlapped ingest: decode ‖ H2D/device ‖ drain as a staged pipeline.
+
+The round-5 e2e budget (BENCH_r05.json) was almost perfectly
+serialized: decode 26.1 s, device wait 21.4 s, drain 4.9 s of a 57.6 s
+wall for 2M entries — the device idle more than half the time while
+the host decoded, the classic host-feed bottleneck that deep request
+pipelining solves (cf. the FPGA ECDSA verification engine's request
+queue, PAPERS.md). This module closes the gap structurally: while
+batch N runs on device, batch N+1 decodes on a background pool through
+the native leafpack path (``decode_raw_batch`` releases the GIL) and
+its H2D transfer is submitted; batch N−1's drain (host-lane readback +
+backend flush) is consumed from a bounded queue on a dedicated thread.
+With decode and device fully overlapped, e2e wall drops toward
+``max(decode, device)`` instead of their sum.
+
+Stage layout (each box a thread or pool; queues are bounded):
+
+    producer ──chunks──▶ [decode pool]      (sink._prepare_chunk)
+                 │ futures, FIFO
+                 ▼
+             [submit thread]                (sink._submit_chunk, under
+                 │ drain queue, ≤ depth      the dispatch lock; device
+                 ▼                           steps dispatch async)
+             [drain consumer]               (sink._complete_item:
+                                             readback + PEM fold)
+
+Ordering contract: chunks are SUBMITTED to the device in exactly the
+order the producer handed them in (decode runs ahead out of order, a
+reorder point at the submit thread restores it), and completions are
+FIFO — so the dedup table sees the same insertion order as the serial
+path and results are parity-identical (asserted by
+tests/test_overlap.py and the bench smoke gate).
+
+Failure contract: a stage exception (decode worker raise, submit
+failure, drain failure) latches the pipeline into a failed state —
+``submit_chunk``/``drain_all``/``close`` re-raise it as
+:class:`OverlapError`, queues keep draining so nothing hangs, and
+already-submitted device work is still completed (the aggregator's
+counts stay exact for everything that reached the device).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ct_mapreduce_tpu.telemetry import metrics
+
+
+class OverlapError(RuntimeError):
+    """A pipeline stage failed; the original exception is ``__cause__``."""
+
+
+_SENTINEL = object()
+
+
+class OverlapIngestPipeline:
+    """Three-stage overlap scheduler over one :class:`AggregatorSink`.
+
+    ``decode_workers`` sizes the decode pool (each worker runs the
+    whole native chunk decode, which itself fans out across cores with
+    the GIL released); ``queue_depth`` bounds device batches that are
+    submitted-but-undrained — the double-buffer depth. Memory bound:
+    at most ``decode_workers + 1`` prepared chunks plus ``queue_depth``
+    in-flight device batches are alive at once.
+    """
+
+    def __init__(self, sink, decode_workers: int = 2, queue_depth: int = 2,
+                 max_prepared: Optional[int] = None):
+        self._sink = sink
+        self.decode_workers = max(1, int(decode_workers))
+        self.queue_depth = max(1, int(queue_depth))
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.decode_workers, thread_name_prefix="ovl-decode"
+        )
+        # Reorder point: decode futures in producer order. The submit
+        # loop waits on the HEAD future, so device submission order ==
+        # producer order regardless of decode completion order.
+        self._order_q: "queue.Queue" = queue.Queue()
+        # Double buffer: blocks the submit loop once `queue_depth`
+        # batches are submitted-but-undrained.
+        self._drain_q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
+        self._failed = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._exc_lock = threading.Lock()
+        # Bound decoded-but-unsubmitted chunks (each pins ~chunk bytes
+        # twice: packed host rows + the enqueued device buffer).
+        self._prepared_sem = threading.BoundedSemaphore(
+            max_prepared or self.decode_workers + 1
+        )
+        self._closed = False
+        # Per-stage busy seconds (wall time spent inside the stage) —
+        # the occupancy gauges bench.py reports. Busy sums exceeding
+        # the wall clock is the overlap actually happening.
+        self.busy = {"decode": 0.0, "submit": 0.0, "drain": 0.0}
+        self._busy_lock = threading.Lock()
+        self._submit_t = threading.Thread(
+            target=self._submit_loop, name="ovl-submit", daemon=True)
+        self._drain_t = threading.Thread(
+            target=self._drain_loop, name="ovl-drain", daemon=True)
+        self._submit_t.start()
+        self._drain_t.start()
+
+    # -- producer side ---------------------------------------------------
+    def submit_chunk(self, pairs) -> None:
+        """Enqueue one raw (leaf_input, extra_data) chunk for the
+        pipeline. Blocks when the decode stage is saturated
+        (backpressure toward the downloader queue); raises
+        :class:`OverlapError` once any stage has failed."""
+        if self._closed:
+            raise OverlapError("overlap pipeline is closed")
+        self._raise_if_failed()
+        while not self._prepared_sem.acquire(timeout=0.1):
+            # select{failure | slot} — a dead submit loop must surface
+            # as an error here, never as a hung producer.
+            self._raise_if_failed()
+        try:
+            fut = self._pool.submit(self._decode_one, pairs)
+        except BaseException:
+            self._prepared_sem.release()
+            raise
+        self._order_q.put(fut)
+
+    def drain_all(self) -> None:
+        """Barrier: block until every chunk submitted so far is decoded,
+        stepped, and folded; re-raise the first stage failure. Markers
+        flow through both stage loops even after a failure (the loops
+        keep consuming), so this never hangs on a failed pipeline."""
+        if self._closed:
+            self._raise_if_failed()
+            return
+        marker = threading.Event()
+        self._order_q.put(marker)
+        while not marker.wait(timeout=0.25):
+            if not self._drain_t.is_alive():
+                break  # closed underneath us; nothing left in flight
+        self._raise_if_failed()
+
+    def close(self) -> None:
+        """Stop the stage threads after the work in flight finishes and
+        re-raise any latched stage failure. Idempotent."""
+        if not self._closed:
+            self._closed = True
+            self._order_q.put(_SENTINEL)
+            self._pool.shutdown(wait=True)
+            self._submit_t.join(timeout=60.0)
+            self._drain_t.join(timeout=60.0)
+        self._raise_if_failed()
+
+    def occupancy(self, wall_s: float) -> dict[str, float]:
+        """Per-stage busy fraction of ``wall_s``, also published as
+        ``overlap.<stage>_occupancy`` gauges."""
+        with self._busy_lock:
+            busy = dict(self.busy)
+        out = {}
+        for stage, busy_s in busy.items():
+            frac = busy_s / wall_s if wall_s > 0 else 0.0
+            out[stage] = frac
+            metrics.set_gauge("overlap", f"{stage}_occupancy", value=frac)
+        return out
+
+    # -- stage bodies ----------------------------------------------------
+    def _decode_one(self, pairs):
+        t0 = time.perf_counter()
+        try:
+            return self._sink._prepare_chunk(pairs)
+        finally:
+            self._add_busy("decode", time.perf_counter() - t0)
+
+    def _submit_loop(self) -> None:
+        while True:
+            item = self._order_q.get()
+            if item is _SENTINEL:
+                self._drain_q.put(_SENTINEL)
+                return
+            if isinstance(item, threading.Event):  # drain_all barrier
+                self._drain_q.put(item)
+                continue
+            try:
+                prep = item.result()
+            except BaseException as err:
+                self._prepared_sem.release()
+                self._fail(err)
+                continue  # keep consuming so close()/drain_all() return
+            if self._failed.is_set():
+                self._prepared_sem.release()
+                continue
+            t0 = time.perf_counter()
+            try:
+                with self._sink._dispatch_lock, metrics.measure(
+                        "ct-fetch", "storeCertificate"):
+                    work = self._sink._submit_chunk(prep)
+            except BaseException as err:
+                self._fail(err)
+                continue
+            finally:
+                self._prepared_sem.release()
+                self._add_busy("submit", time.perf_counter() - t0)
+            for kind, payload, der_of in work:
+                self._drain_q.put((kind, payload, der_of))
+
+    def _drain_loop(self) -> None:
+        while True:
+            item = self._drain_q.get()
+            if item is _SENTINEL:
+                return
+            if isinstance(item, threading.Event):
+                item.set()
+                continue
+            kind, payload, der_of = item
+            t0 = time.perf_counter()
+            try:
+                if kind == "pending":
+                    self._sink._complete_item(payload, der_of)
+                else:  # "result": oversized exact lane, already folded
+                    self._sink._store_pems(payload, der_of)
+            except BaseException as err:
+                self._fail(err)
+            finally:
+                self._add_busy("drain", time.perf_counter() - t0)
+
+    # -- shared plumbing -------------------------------------------------
+    def _add_busy(self, stage: str, seconds: float) -> None:
+        with self._busy_lock:
+            self.busy[stage] += seconds
+
+    def _fail(self, err: BaseException) -> None:
+        with self._exc_lock:
+            if self._exc is None:
+                self._exc = err
+        self._failed.set()
+        metrics.incr_counter("overlap", "stage_error")
+
+    def _raise_if_failed(self) -> None:
+        if self._failed.is_set():
+            raise OverlapError(
+                f"overlap pipeline stage failed: {self._exc!r}"
+            ) from self._exc

@@ -129,7 +129,8 @@ class AggregatorSink:
     # above it take the exact host lane, like oversized serials)
 
     def __init__(self, aggregator, flush_size: int = 4096, backend=None,
-                 device_queue_depth: int = 2, decode_workers: int = 0):
+                 device_queue_depth: int = 2, decode_workers: int = 0,
+                 overlap_workers: int = 0):
         self.aggregator = aggregator
         self.flush_size = flush_size
         # Optional durable backend (certPath): first-seen certs get the
@@ -137,6 +138,7 @@ class AggregatorSink:
         # reference writes (filesystemdatabase.go:189-208).
         self.backend = backend
         self._allocated: set[tuple[str, str]] = set()
+        self._pem_lock = threading.Lock()  # overlap drains from a thread
         self._pending: list[tuple[bytes, bytes]] = []
         self._pending_raw: list[tuple[str, str]] = []
         self._lock = threading.Lock()
@@ -157,6 +159,21 @@ class AggregatorSink:
         # materializing them when it can (count-only fast path).
         aggregator.want_serials = backend is not None
         self.entries_in = 0
+        # Overlapped ingest (overlapWorkers > 0): raw chunks route
+        # through a three-stage scheduler — decode pool ‖ ordered
+        # device submit ‖ bounded drain consumer — instead of the
+        # caller-thread decode→submit→drain sequence above. Exact
+        # same decode/submit/complete primitives, so results are
+        # parity-identical; only the threading changes.
+        self.overlap_workers = max(0, int(overlap_workers))
+        self._overlap = None
+        if self.overlap_workers:
+            from ct_mapreduce_tpu.ingest.overlap import OverlapIngestPipeline
+
+            self._overlap = OverlapIngestPipeline(
+                self, decode_workers=self.overlap_workers,
+                queue_depth=max(1, self.device_queue_depth),
+            )
 
     def store(self, entry: DecodedEntry, log_url: str) -> None:
         if entry.issuer_der is None:
@@ -185,6 +202,26 @@ class AggregatorSink:
             self._dispatch_raw(chunk)
 
     def _dispatch_raw(self, pairs: list[tuple[str, str]]) -> None:
+        if self._overlap is not None:
+            # Overlapped mode: the chunk enters the three-stage
+            # scheduler; decode happens on its pool, submission on its
+            # ordered submit thread, completion on its drain consumer.
+            self._overlap.submit_chunk(pairs)
+            return
+        prep = self._prepare_chunk(pairs)
+        with self._dispatch_lock, metrics.measure("ct-fetch",
+                                                  "storeCertificate"):
+            for item in self._submit_chunk(prep):
+                if item[0] == "pending":
+                    self._inflight.append((item[1], item[2]))
+                else:  # oversized-lane result: fold PEMs immediately
+                    self._store_pems(item[1], item[2])
+            self._drain_inflight(self.device_queue_depth)
+
+    def _prepare_chunk(self, pairs: list[tuple[str, str]]) -> "_PreparedChunk":
+        """Stage 1 — decode + pack + H2D submit, NO aggregator-state
+        mutation beyond the (thread-safe) issuer registry: safe to run
+        on any thread, concurrently with device work and drains."""
         from ct_mapreduce_tpu.ingest.leaf import LeafDecodeError, decode_entry
         from ct_mapreduce_tpu.native import leafpack
 
@@ -255,11 +292,18 @@ class AggregatorSink:
         issuer_idx[valid] = mapped[valid]
         bad_issuer = int((ok & (mapped < 0)).sum())
         no_chain = int((dec.status == leafpack.NO_CHAIN).sum())
-        too_long = np.nonzero(dec.status == leafpack.TOO_LONG)[0]
+        # Both oversize flavors take the exact per-entry lane; only
+        # cert-exceeds-pad (TOO_LONG) ever warranted the full-width
+        # redecode above — issuer-oversize (ISSUER_TOO_LONG) certs
+        # packed fine and a wider row cannot change their status.
+        too_long = np.nonzero(
+            (dec.status == leafpack.TOO_LONG)
+            | (dec.status == leafpack.ISSUER_TOO_LONG))[0]
         other_bad = int(
             ((dec.status != leafpack.OK)
              & (dec.status != leafpack.NO_CHAIN)
-             & (dec.status != leafpack.TOO_LONG)).sum()
+             & (dec.status != leafpack.TOO_LONG)
+             & (dec.status != leafpack.ISSUER_TOO_LONG)).sum()
         )
         if bad_issuer or other_bad:
             metrics.incr_counter("ct-fetch", "parseLeafError",
@@ -287,11 +331,12 @@ class AggregatorSink:
         # Start the H2D transfer of the big byte rows BEFORE taking the
         # dispatch lock: device_put enqueues asynchronously, so the
         # transfer of batch N+1 overlaps the device step of batch N
-        # (the decode half of the overlap comes from the bounded
-        # in-flight queue below). Small arrays stay host-side — the
-        # aggregator reads them for bookkeeping. Tail chunks (not a
-        # multiple of the compiled batch shape) take the NumPy path:
-        # their padding copy happens host-side in the aggregator.
+        # (the decode half of the overlap comes from the decode stage
+        # running ahead of the submit stage). Small arrays stay
+        # host-side — the aggregator reads them for bookkeeping. Tail
+        # chunks (not a multiple of the compiled batch shape) take the
+        # NumPy path: their padding copy happens host-side in the
+        # aggregator.
         data_host = data
         if valid.any() and data.shape[0] % self.aggregator.batch_size == 0:
             import jax
@@ -301,37 +346,60 @@ class AggregatorSink:
             # previous step and any residual lands in completeBatch.
             with metrics.measure("ct-fetch", "h2dSubmit"):
                 data = jax.device_put(data)
-        with self._dispatch_lock, metrics.measure("ct-fetch", "storeCertificate"):
-            if valid.any():
-                pending = self.aggregator.ingest_packed_submit(
-                    data, dec.length, issuer_idx, valid, host_data=data_host
-                )
-                self._inflight.append((
-                    pending,
-                    lambda pos, _d=dec: _d.data[pos, : _d.length[pos]].tobytes(),
-                ))
-            if oversized:
-                res_over = self.aggregator.ingest(oversized)
-                self._store_pems(res_over, lambda pos: oversized[pos][0])
-            self._drain_inflight(self.device_queue_depth)
-        metrics.incr_counter(
-            "ct-fetch", "insertCertificate",
-            value=float(int(valid.sum()) + len(oversized)),
+        return _PreparedChunk(
+            data=data, host_data=data_host, length=dec.length,
+            issuer_idx=issuer_idx, valid=valid, dec=dec,
+            oversized=oversized,
         )
 
-    def _drain_inflight(self, keep: int) -> None:
-        """Complete submitted device work until at most ``keep`` batches
-        remain in flight. Caller holds ``_dispatch_lock``.
+    def _submit_chunk(self, prep: "_PreparedChunk") -> list[tuple]:
+        """Stage 2 — dispatch the device step(s) for a prepared chunk.
+        Caller MUST hold ``_dispatch_lock`` (one device stream; the
+        donated table state serializes submissions). Returns drain
+        items: ``("pending", PendingIngest, der_of)`` entries whose
+        ``complete()`` is stage 3, and ``("result", IngestResult,
+        der_of)`` entries (the rare oversized exact lane, already
+        complete) that only need PEM folding."""
+        items: list[tuple] = []
+        if prep.valid.any():
+            pending = self.aggregator.ingest_packed_submit(
+                prep.data, prep.length, prep.issuer_idx, prep.valid,
+                host_data=prep.host_data,
+            )
+            dec = prep.dec
+            items.append((
+                "pending", pending,
+                lambda pos, _d=dec: _d.data[pos, : _d.length[pos]].tobytes(),
+            ))
+        if prep.oversized:
+            oversized = prep.oversized
+            res_over = self.aggregator.ingest(oversized)
+            items.append((
+                "result", res_over, lambda pos, _o=oversized: _o[pos][0],
+            ))
+        metrics.incr_counter(
+            "ct-fetch", "insertCertificate",
+            value=float(int(prep.valid.sum()) + len(prep.oversized)),
+        )
+        return items
+
+    def _complete_item(self, pending, der_of) -> None:
+        """Stage 3 — block on one batch's device work and fold it.
 
         The completeBatch sample is where the pipeline's device wait
         really lives: device execution + D2H readback + the exact
         host-lane work for flagged lanes — the counterpart of the
         (async-enqueue) storeCertificate/h2dSubmit samples."""
+        with metrics.measure("ct-fetch", "completeBatch"):
+            res = pending.complete()
+        self._store_pems(res, der_of)
+
+    def _drain_inflight(self, keep: int) -> None:
+        """Complete submitted device work until at most ``keep`` batches
+        remain in flight. Caller holds ``_dispatch_lock``."""
         while len(self._inflight) > keep:
             pending, der_of = self._inflight.popleft()
-            with metrics.measure("ct-fetch", "completeBatch"):
-                res = pending.complete()
-            self._store_pems(res, der_of)
+            self._complete_item(pending, der_of)
 
     def flush(self) -> None:
         with self._lock:
@@ -341,13 +409,30 @@ class AggregatorSink:
             self._dispatch(batch)
         if raw:
             self._dispatch_raw(raw)
+        if self._overlap is not None:
+            # Barrier through the scheduler: every chunk handed to it is
+            # decoded, stepped, and folded before flush returns (and any
+            # stage failure surfaces here).
+            self._overlap.drain_all()
         # Same storeCertificate envelope as the dispatch path, so every
         # completeBatch sample is NESTED inside a storeCertificate
         # sample — the bench's budget breakdown subtracts one from the
-        # other and flush-path completes must not skew it.
+        # other and flush-path completes must not skew it. (In overlap
+        # mode completes are NOT nested — they run on the drain thread
+        # — and the bench computes the budget accordingly.)
         with self._dispatch_lock, metrics.measure("ct-fetch",
                                                   "storeCertificate"):
             self._drain_inflight(0)
+
+    def close(self) -> None:
+        """Flush, then stop the overlap scheduler's threads (no-op in
+        serial mode). The sink remains usable for serial dispatch."""
+        try:
+            self.flush()
+        finally:
+            if self._overlap is not None:
+                overlap, self._overlap = self._overlap, None
+                overlap.close()
 
     def checkpointed_save(self, save_fn) -> None:
         """Flush pending entries, then run ``save_fn`` while holding the
@@ -385,24 +470,41 @@ class AggregatorSink:
         from ct_mapreduce_tpu.core.types import ExpDate, Serial
 
         reg = self.aggregator.registry
-        dirty_days: set[str] = set()
-        for pos, sb in enumerate(result.serials):
-            if sb is None or result.filtered[pos]:
-                continue
-            exp = ExpDate.from_unix_hour(int(result.exp_hours[pos]))
-            dirty_days.add(exp.date.strftime("%Y-%m-%d"))
-            if not result.was_unknown[pos]:
-                continue
-            issuer = reg.issuer_at(int(result.issuer_idx[pos]))
-            pair = (exp.id(), issuer.id())
-            if pair not in self._allocated:
-                self.backend.allocate_exp_date_and_issuer(exp, issuer)
-                self._allocated.add(pair)
-            self.backend.store_certificate_pem(
-                Serial(sb), exp, issuer, der_to_pem(der_of(pos))
-            )
-        for day in dirty_days:
-            self.backend.mark_dirty(day)
+        with self._pem_lock:  # overlap drains + per-entry path may race
+            dirty_days: set[str] = set()
+            for pos, sb in enumerate(result.serials):
+                if sb is None or result.filtered[pos]:
+                    continue
+                exp = ExpDate.from_unix_hour(int(result.exp_hours[pos]))
+                dirty_days.add(exp.date.strftime("%Y-%m-%d"))
+                if not result.was_unknown[pos]:
+                    continue
+                issuer = reg.issuer_at(int(result.issuer_idx[pos]))
+                pair = (exp.id(), issuer.id())
+                if pair not in self._allocated:
+                    self.backend.allocate_exp_date_and_issuer(exp, issuer)
+                    self._allocated.add(pair)
+                self.backend.store_certificate_pem(
+                    Serial(sb), exp, issuer, der_to_pem(der_of(pos))
+                )
+            for day in dirty_days:
+                self.backend.mark_dirty(day)
+
+
+@dataclass
+class _PreparedChunk:
+    """Output of the ingest pipeline's decode stage: one raw chunk
+    decoded, packed, issuer-mapped, and (when full-batch-shaped) with
+    its H2D transfer already submitted — everything the device submit
+    stage needs, computed without any aggregator-state mutation."""
+
+    data: object  # uint8[n, pad] rows — device array (H2D enqueued) or np
+    host_data: np.ndarray  # host-resident copy for host-lane slices
+    length: np.ndarray  # int32[n]
+    issuer_idx: np.ndarray  # int32[n] registry indices
+    valid: np.ndarray  # bool[n]
+    dec: object  # the DecodedBatch (host rows for PEM der_of slicing)
+    oversized: list  # [(cert_der, issuer_der)] exact-lane entries
 
 
 @dataclass

@@ -103,8 +103,11 @@ enum {
   CTMR_BAD_LEAF = 2,
   CTMR_UNSUPPORTED = 3,   // version/leaf_type/entry_type unknown
   CTMR_NO_CHAIN = 4,      // no issuer certificate in extra_data
-  CTMR_TOO_LONG = 5,      // cert exceeds pad_len, or issuer DER >=
-                          // 2 MiB (either way: exact host lane)
+  CTMR_TOO_LONG = 5,      // cert exceeds pad_len (a wider redecode
+                          // can clear it; exact host lane otherwise)
+  CTMR_ISSUER_TOO_LONG = 6,  // issuer DER >= 2 MiB: the cert itself
+                          // packed fine, so a wider redecode is futile
+                          // — straight to the exact host lane
 };
 
 // Decode one get-entries batch and pack leaf certificates.
@@ -254,7 +257,9 @@ int64_t ctmr_decode_entries(
       // Pathological >=2 MiB issuer DER: the Python span packing
       // (off*2^21 + len) requires len < 2^21, so route the entry down
       // the exact per-entry host lane instead of risking aliasing.
-      status[i] = CTMR_TOO_LONG;
+      // Distinct from CTMR_TOO_LONG: the cert row IS packed, so the
+      // caller must not trigger a full-width batch redecode for it.
+      status[i] = CTMR_ISSUER_TOO_LONG;
       continue;
     }
     const uint8_t* iss_src = ed_scratch + chain_issuer_off;

@@ -25,7 +25,9 @@ BAD_B64 = 1
 BAD_LEAF = 2
 UNSUPPORTED = 3
 NO_CHAIN = 4
-TOO_LONG = 5
+TOO_LONG = 5  # cert exceeds pad_len — a wider redecode can clear it
+ISSUER_TOO_LONG = 6  # issuer DER >= 2 MiB — cert packed fine; a wider
+# redecode is futile, the entry goes straight to the exact host lane
 
 
 @dataclass
@@ -101,7 +103,11 @@ def decode_raw_batch(
     import os
 
     n = len(leaf_inputs)
-    lib = load_native()
+    # CTMR_NATIVE=0 forces the pure-Python lane (read per call, not at
+    # load: the bench's CPU smoke flips it mid-process to rebalance the
+    # decode stage; results are byte-identical by the conformance suite).
+    lib = (None if os.environ.get("CTMR_NATIVE", "1") == "0"
+           else load_native())
     if lib is None:
         return _decode_python(leaf_inputs, extra_datas, pad_len)
 
@@ -284,8 +290,9 @@ def _decode_python(
             # Native-path parity: pathological >=2 MiB issuer DERs are
             # routed down the exact host lane (span-packing bound). The
             # cert row stays packed, exactly like the native decoder
-            # (which packs before its issuer-length check).
-            status[i] = TOO_LONG
+            # (which packs before its issuer-length check) — hence the
+            # dedicated status: callers must not redecode wider for it.
+            status[i] = ISSUER_TOO_LONG
         else:
             issuers[i] = e.issuer_der
     # Grouping for the vectorized sink path (dict-based — this is the

@@ -331,3 +331,17 @@ ingest_step = functools.partial(
     static_argnames=("num_issuers", "max_probes"),
     donate_argnums=(0,),
 )(ingest_core)
+
+# Overlapped-ingest entry point: donates the packed row buffer too.
+# The overlap scheduler hands the step a device-resident batch it will
+# never touch again (host-lane fallbacks slice the separate host copy),
+# so donating `data` lets XLA reuse ~batch-size HBM per in-flight batch
+# instead of holding the input rows live alongside the step's
+# intermediates — at deviceQueueDepth 2 that is two full batches of
+# headroom. Callers that keep NumPy rows (tail chunks, the synchronous
+# per-entry path) stay on `ingest_step`.
+ingest_step_donated = functools.partial(
+    jax.jit,
+    static_argnames=("num_issuers", "max_probes"),
+    donate_argnums=(0, 1),
+)(ingest_core)

@@ -88,12 +88,14 @@ def device_barrier(tag: str = "barrier") -> None:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     import numpy as np
 
+    from ct_mapreduce_tpu.utils.jax_compat import shard_map
+
     devices = np.asarray(jax.devices())
     mesh = Mesh(devices, ("all",))
 
     @jax.jit
     def _reduce(x):
-        return jax.shard_map(
+        return shard_map(
             lambda v: jax.lax.psum(v, "all"),
             mesh=mesh,
             in_specs=P("all"),

@@ -1,0 +1,23 @@
+"""Version portability shims for jax APIs the pipeline depends on.
+
+The sharded step is written against the stable ``jax.shard_map``
+(jax >= 0.6); older runtimes (0.4.x, e.g. the CI container) only carry
+``jax.experimental.shard_map.shard_map`` with the pre-rename
+``check_rep`` keyword. One call-site-compatible wrapper keeps the agg
+and parallel layers off version probes.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    import jax
+
+    stable = getattr(jax, "shard_map", None)
+    if stable is not None:
+        return stable(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as experimental
+
+    return experimental(f, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=check_vma)

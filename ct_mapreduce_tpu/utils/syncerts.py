@@ -45,10 +45,20 @@ def _build_pair(
     serial_len: int = SERIAL_LEN,
     rich_extensions: bool = False,
 ) -> tuple[bytes, bytes]:
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec, rsa
-    from cryptography.x509.oid import NameOID
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec, rsa
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        # Hosts without the cryptography package (some CI containers)
+        # fall back to the hand-assembled canonical-DER builder: same
+        # parse/filter/fingerprint behavior, synthetic signature bytes
+        # (nothing on the ingest path verifies). Row-size realism is
+        # approximated with opaque extension padding.
+        return _build_pair_minicert(
+            issuer_cn, not_after, crl_dp, key_type=key_type,
+            serial_len=serial_len, rich_extensions=rich_extensions)
 
     # Real CT logs are RSA-dominated (~1.2-1.9 KB DER vs ~0.8 KB for
     # ECDSA P-256): RSA templates exist so benchmarks can measure the
@@ -188,6 +198,38 @@ def _build_pair(
     leaf_der = leaf_builder.sign(key, hashes.SHA256()).public_bytes(
         serialization.Encoding.DER
     )
+    return leaf_der, issuer_der
+
+
+def _build_pair_minicert(
+    issuer_cn: str,
+    not_after: datetime.datetime,
+    crl_dp: str | None,
+    key_type: str = "ec",
+    serial_len: int = SERIAL_LEN,
+    rich_extensions: bool = False,
+) -> tuple[bytes, bytes]:
+    from ct_mapreduce_tpu.utils import minicert
+
+    if key_type not in ("ec", "rsa2048"):
+        raise ValueError(f"unknown key_type {key_type!r} (ec | rsa2048)")
+    # Size realism without a signer: RSA-2048 leaves carry ~550 B more
+    # key+signature DER than P-256; the production extension load adds
+    # ~700 B (SAN/AIA/KU/EKU/SKI/AKI/policies/SCTs) — pad with one
+    # opaque extension so row-byte-proportional code paths (narrow
+    # pre-decode, H2D volume) see the same regime.
+    extra = 0
+    if key_type == "rsa2048":
+        extra += 550
+    if rich_extensions:
+        extra += 700
+    issuer_der = minicert.make_cert(
+        serial=1, issuer_cn=issuer_cn, is_ca=True, not_after=not_after)
+    leaf_der = minicert.make_cert(
+        serial=0, issuer_cn=issuer_cn, subject_cn="bench.example.com",
+        is_ca=False, not_after=not_after,
+        crl_dps=(crl_dp,) if crl_dp else (),
+        serial_len=serial_len, extra_ext_bytes=extra)
     return leaf_der, issuer_der
 
 

@@ -4,23 +4,43 @@ Mirrors the reference's on-the-fly fixture generation (`makeCert`,
 /root/reference/storage/issuermetadata_test.go:62-98): self-signed CA
 certs with chosen DN / expiry / serial / CRL distribution points, built
 with the `cryptography` package.
+
+Hosts without `cryptography` (some CI containers) fall back to
+`ct_mapreduce_tpu.utils.minicert`'s hand-assembled canonical DER: same
+fields in the same places with a deterministic per-key-seed SPKI, only
+the signature bytes are synthetic — the contract of every consumer
+here, which parses and never verifies. Tests that need the real
+package (signed RSA/PSS fixtures, cryptography-as-ground-truth
+comparisons) gate on :data:`requires_cryptography`.
 """
 
 from __future__ import annotations
 
 import datetime
+import itertools
 from functools import lru_cache
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+import pytest
 
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
 
-@lru_cache(maxsize=8)
-def _key(seed: int = 0):
-    # Key generation dominates fixture cost; cache a few keys.
-    return ec.generate_private_key(ec.SECP256R1())
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:
+    HAVE_CRYPTOGRAPHY = False
+
+requires_cryptography = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY, reason="needs the cryptography package"
+)
+
+if HAVE_CRYPTOGRAPHY:
+    @lru_cache(maxsize=8)
+    def _key(seed: int = 0):
+        # Key generation dominates fixture cost; cache a few keys.
+        return ec.generate_private_key(ec.SECP256R1())
 
 
 def make_cert(
@@ -40,6 +60,14 @@ def make_cert(
     extras_first: bool = True,
 ) -> bytes:
     """Build a self-signed certificate, returning DER bytes."""
+    if not HAVE_CRYPTOGRAPHY:
+        return _make_cert_minicert(
+            serial=serial, issuer_cn=issuer_cn, subject_cn=subject_cn,
+            org=org, country=country, not_before=not_before,
+            not_after=not_after, crl_dps=crl_dps, is_ca=is_ca,
+            add_basic_constraints=add_basic_constraints, key_seed=key_seed,
+            extra_extensions=extra_extensions,
+            extra_ext_size=extra_ext_size, extras_first=extras_first)
     now = datetime.datetime(2024, 1, 1, tzinfo=datetime.timezone.utc)
     not_before = not_before or now
     not_after = not_after or now + datetime.timedelta(days=365)
@@ -113,8 +141,62 @@ def make_cert(
     return cert.public_bytes(serialization.Encoding.DER)
 
 
+# Fallback serials: deterministic stand-in for random_serial_number()
+# (callers only rely on distinctness across calls).
+_serial_counter = itertools.count(0x6E00_0000_0001)
+
+
+def _make_cert_minicert(*, serial, issuer_cn, subject_cn, org, country,
+                        not_before, not_after, crl_dps, is_ca,
+                        add_basic_constraints, key_seed, extra_extensions,
+                        extra_ext_size, extras_first) -> bytes:
+    from ct_mapreduce_tpu.utils import minicert
+
+    now = datetime.datetime(2024, 1, 1, tzinfo=datetime.timezone.utc)
+    return minicert.make_cert(
+        serial=next(_serial_counter) if serial is None else serial,
+        issuer_cn=issuer_cn, subject_cn=subject_cn, org=org,
+        country=country, not_before=not_before or now,
+        not_after=not_after or now + datetime.timedelta(days=365),
+        is_ca=is_ca, add_basic_constraints=add_basic_constraints,
+        crl_dps=tuple(crl_dps),
+        serial_len=None,  # minimal DER INTEGER, like the builder
+        # cryptography keys depend only on key_seed (not the CN), so
+        # certs sharing a seed share an SPKI identity — preserve that.
+        spki_seed=f"certgen-key:{key_seed}",
+        extra_extensions=extra_extensions, extra_ext_size=extra_ext_size,
+        extras_first=extras_first)
+
+
 def spki_of(der: bytes) -> bytes:
-    cert = x509.load_der_x509_certificate(der)
-    return cert.public_key().public_bytes(
-        serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
-    )
+    if HAVE_CRYPTOGRAPHY:
+        cert = x509.load_der_x509_certificate(der)
+        return cert.public_key().public_bytes(
+            serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
+        )
+    # Minimal TLV walk, independent of the production parser (so the
+    # parser tests that compare against spki_of stay a real check):
+    # Certificate -> tbsCertificate -> [version] serial sigalg issuer
+    # validity subject -> subjectPublicKeyInfo.
+    def header(off: int) -> tuple[int, int, int]:
+        tag, first = der[off], der[off + 1]
+        off += 2
+        if first & 0x80:
+            n = first & 0x7F
+            first = int.from_bytes(der[off:off + n], "big")
+            off += n
+        return tag, off, first
+
+    _, cert_content, _ = header(0)          # Certificate SEQ
+    _, tbs_content, _ = header(cert_content)  # tbsCertificate SEQ
+    off = tbs_content
+    tag, content_off, content_len = header(off)
+    if tag == 0xA0:  # explicit [0] version
+        off = content_off + content_len
+    for _ in range(4):  # serial, signature alg, issuer, validity
+        _, content_off, content_len = header(off)
+        off = content_off + content_len
+    _, content_off, content_len = header(off)  # subject
+    off = content_off + content_len
+    _, content_off, content_len = header(off)  # SPKI
+    return der[off:content_off + content_len]

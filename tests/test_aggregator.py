@@ -16,7 +16,7 @@ from ct_mapreduce_tpu.core import der as hostder
 from ct_mapreduce_tpu.core import packing
 from ct_mapreduce_tpu.core.types import ExpDate, Issuer
 
-from certgen import make_cert, spki_of
+from certgen import make_cert, requires_cryptography, spki_of
 
 UTC = datetime.timezone.utc
 NOW = datetime.datetime(2024, 6, 1, tzinfo=UTC)
@@ -268,6 +268,7 @@ def test_cn_prefix_filter_through_aggregator():
     assert a.metrics["filtered_cn"] == 1
 
 
+@requires_cryptography
 def test_rsa_certificates_device_path():
     """RSA certs (the dominant real-CT key type): ~270-byte SPKI and a
     different AlgorithmIdentifier shape than every ECDSA fixture in

@@ -1,0 +1,37 @@
+"""CI gate for bench.py's CPU smoke path (CT_BENCH_SMOKE=1).
+
+Locks the overlapped-ingest pipeline into tier-1: run_smoke() asserts
+serial/overlap parity (table_count, host_lane, drained counts), the
+rediscache serial sets, AND the overlap inequality — overlapped wall
+< 0.85 × (decode + device_wait + drain) on the same run — so the
+pipeline cannot silently regress to serialized stages without failing
+the suite.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.timeout(120)
+def test_bench_smoke_overlap_gate(monkeypatch):
+    # Same ambient-sitecustomize workaround as bench.main(): keep the
+    # smoke on CPU even outside pytest/conftest (run_smoke also forces
+    # the cpu platform itself).
+    import jax
+
+    if os.environ.get("CT_TPU_TESTS", "") == "":
+        jax.config.update("jax_platforms", "cpu")
+    import bench
+
+    out = bench.run_smoke()  # raises BenchError on any parity/gate miss
+    assert out["metric"] == "ct_e2e_smoke"
+    assert out["smoke_entries"] == out["smoke_table_count"]
+    assert out["smoke_overlap_ratio"] < 0.85
+    assert out["value"] > 0
+    # The stage budget really was measured (not zeroed by a silent
+    # metrics-sink regression).
+    assert out["smoke_decode_s"] > 0 and out["smoke_device_wait_s"] > 0

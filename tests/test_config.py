@@ -85,7 +85,11 @@ def test_tpu_directives(tmp_path):
                     "tableGrowAt = 0\ntableMaxBits = 20\nbatchSize = 64\n")
     agg = build_aggregator(CTConfig.load(argv=["--config", str(ini2)], env={}))
     assert agg.grow_at == 0
-    assert agg.max_capacity == 1 << 20
+    # The configured 2^20 ceiling is floored to the largest capacity
+    # the active layout can actually build (bucket: 24·2^k), so the
+    # at-ceiling growth guard can fire (ADVICE r05 grow-livelock fix).
+    assert agg.max_capacity == agg._layout_capacity_floor(1 << 20)
+    assert 0 < agg.max_capacity <= 1 << 20
 
 
 def test_usage_mentions_every_reference_directive():

@@ -13,7 +13,7 @@ import pytest
 from ct_mapreduce_tpu.core import der as hostder
 from ct_mapreduce_tpu.ops import der_kernel
 
-from certgen import make_cert
+from certgen import make_cert, requires_cryptography
 
 UTC = datetime.timezone.utc
 
@@ -139,6 +139,7 @@ def test_extension_scan_superblock_stress():
     assert bool(out.ok[4]) and bool(out.is_ca[4])
 
 
+@requires_cryptography
 def test_rsassa_pss_on_device_path():
     """An RSASSA-PSS-signed certificate (~67-byte signature
     AlgorithmIdentifier frame) must stay ON the device path: the fixed

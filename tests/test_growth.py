@@ -41,8 +41,11 @@ def test_auto_grow_preserves_dedup_and_counts():
     tmetrics.set_sink(sink)
     a = TpuAggregator(capacity=256, batch_size=64, now=NOW,
                       grow_at=0.6, max_capacity=(1 << 12) + 7)
-    # A ragged ceiling rounds DOWN to a power of two at construction.
-    assert a.max_capacity == 1 << 12
+    # A ragged ceiling rounds DOWN to a power of two, then to the
+    # layout-achievable capacity (bucket: 24·2^k — the r05 grow-
+    # livelock fix; see tests/test_growth_ceiling.py).
+    assert a.max_capacity == a._layout_capacity_floor(1 << 12)
+    assert a.max_capacity <= 1 << 12
     start_cap = a.capacity  # layout may round the requested 256 up
     assert 300 > a.grow_at * start_cap  # growth must trigger below
     ents = entries(300)

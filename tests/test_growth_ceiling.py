@@ -1,0 +1,80 @@
+"""Grow-livelock regression at the clamped ceiling (ADVICE r05).
+
+The bucket layout can only build 24·2^k slots while the configured
+ceiling rounds to 2^m, so before the fix ``capacity >= max_capacity``
+was unreachable: once fill crossed ``grow_at × capacity`` near the
+ceiling, EVERY batch re-ran a full drain+rebuild+reinsert that
+produced the identical bucket count — multi-minute rebuilds, zero
+slots gained. The fix floors ``max_capacity`` to the layout-achievable
+capacity at construction so the at-ceiling guard can fire.
+
+Pure-minicert fixtures: runs without the ``cryptography`` package.
+"""
+
+import datetime
+
+import numpy as np
+
+from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+from ct_mapreduce_tpu.utils import minicert
+
+UTC = datetime.timezone.utc
+NOW = datetime.datetime(2025, 1, 1, tzinfo=UTC)
+
+ISSUER = minicert.make_cert(serial=1, issuer_cn="Ceil CA", is_ca=True)
+
+
+def entries(start: int, n: int):
+    return [
+        (minicert.make_cert(serial=10_000 + start + i, issuer_cn="Ceil CA",
+                            subject_cn="c.example", is_ca=False), ISSUER)
+        for i in range(n)
+    ]
+
+
+def grow_count(sink) -> int:
+    return int(sink.snapshot()["counters"].get("aggregator.table_grow", 0))
+
+
+def test_ceiling_is_layout_achievable_and_guard_fires():
+    sink = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink)
+    try:
+        a = TpuAggregator(capacity=256, batch_size=64, now=NOW,
+                          grow_at=0.5, max_capacity=768)
+        # 768 = 32 buckets × 24 slots: exactly achievable, so the
+        # ceiling survives the construction-time floor verbatim.
+        assert a.max_capacity == a._layout_capacity_floor(768)
+        assert a.capacity < a.max_capacity
+
+        # Cross the threshold well below the ceiling: exactly ONE
+        # rebuild, landing AT the ceiling.
+        a.ingest(entries(0, 300))
+        assert grow_count(sink) == 1
+        assert a.capacity == a.max_capacity
+
+        # Keep driving fill past grow_at × capacity AT the ceiling —
+        # the pre-fix livelock re-ran a full rebuild per batch here.
+        # The guard must fire instead: zero further rebuilds.
+        a.ingest(entries(300, 300))
+        a.ingest(entries(600, 200))
+        assert grow_count(sink) == 1, "rebuilt at the ceiling (livelock)"
+        assert a.capacity == a.max_capacity
+
+        # Counts stay exact regardless (overflow spills to the exact
+        # host lane past the ceiling).
+        assert a.drain().total == 800
+    finally:
+        tmetrics.set_sink(prev)
+
+
+def test_ragged_ceiling_floors_to_power_of_two_then_layout():
+    a = TpuAggregator(capacity=256, batch_size=64, now=NOW,
+                      grow_at=0.6, max_capacity=(1 << 12) + 7)
+    # 2^12+7 → 2^12 (power-of-two floor) → the layout floor below it.
+    assert a.max_capacity == a._layout_capacity_floor(1 << 12)
+    assert a.max_capacity <= 1 << 12
+    # The floor itself is a fixed point: flooring twice changes nothing.
+    assert a._layout_capacity_floor(a.max_capacity) == a.max_capacity

@@ -670,3 +670,66 @@ def test_raw_batch_narrow_decode_and_redecode():
         assert agg2.drain().total == len(small) + 1
     finally:
         leafpack.decode_raw_batch = orig
+
+
+def test_oversized_issuer_gets_own_status_no_redecode():
+    """ADVICE r05: a >=2 MiB issuer DER used to come back as TOO_LONG,
+    so any batch containing one paid a futile full-width redecode of
+    the whole batch. It now gets ISSUER_TOO_LONG — no redecode — and
+    the entry still lands via the exact per-entry host lane."""
+    import base64
+
+    import numpy as np
+
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.ingest.sync import RawBatch
+    from ct_mapreduce_tpu.native import leafpack
+
+    # A real-signed certificate inflated past the 2 MiB span-packing
+    # bound with one opaque private-arc extension.
+    huge_issuer = certgen.make_cert(
+        serial=1, issuer_cn="Huge CA", is_ca=True, not_after=FUTURE,
+        extra_extensions=1, extra_ext_size=(1 << 21) + 256,
+    )
+    assert len(huge_issuer) >= (1 << 21)
+    normal_issuer = certgen.make_cert(serial=1, issuer_cn="Ovs CA",
+                                      is_ca=True, not_after=FUTURE)
+    small = [certgen.make_cert(serial=80 + i, issuer_cn="Ovs CA",
+                               subject_cn="o.example.com", is_ca=False,
+                               not_after=FUTURE) for i in range(3)]
+    victim = certgen.make_cert(serial=99, issuer_cn="Huge CA",
+                               subject_cn="h.example.com", is_ca=False,
+                               not_after=FUTURE)
+    lis = [base64.b64encode(leaflib.encode_leaf_input(d, i)).decode()
+           for i, d in enumerate(small + [victim])]
+    eds = ([base64.b64encode(
+        leaflib.encode_extra_data([normal_issuer])).decode()] * len(small)
+        + [base64.b64encode(
+            leaflib.encode_extra_data([huge_issuer])).decode()])
+
+    dec = leafpack.decode_raw_batch(lis, eds, 2048)
+    assert dec.status[-1] == leafpack.ISSUER_TOO_LONG
+    assert dec.length[-1] == len(victim)  # cert row packed fine
+    np.testing.assert_array_equal(
+        dec.status, leafpack._decode_python(lis, eds, 2048).status)
+
+    pads_seen = []
+    orig = leafpack.decode_raw_batch
+
+    def spy(l, e, pad_len, workers=None):
+        pads_seen.append(pad_len)
+        return orig(l, e, pad_len, workers=workers)
+
+    agg = TpuAggregator(capacity=1 << 12, batch_size=64,
+                        now=datetime.datetime(2025, 1, 1, tzinfo=UTC))
+    sink = AggregatorSink(agg, flush_size=64)
+    leafpack.decode_raw_batch = spy
+    try:
+        sink.store_raw_batch(RawBatch(lis, eds, 0, "log"))
+        sink.flush()
+    finally:
+        leafpack.decode_raw_batch = orig
+    # Narrow pre-decode, ONE decode — the overloaded status used to
+    # force [narrow, full] here.
+    assert pads_seen == [sink.PAD_LEN // 2], pads_seen
+    assert agg.drain().total == len(small) + 1
