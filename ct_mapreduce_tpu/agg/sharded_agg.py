@@ -114,6 +114,15 @@ class ShardedAggregator(TpuAggregator):
     def _table_fill_exact(self) -> int:
         return self.dedup.total_count()
 
+    def _device_step_preparsed(self, *args, **kwargs):
+        # The pre-parsed lane's fingerprint+insert step is single-chip
+        # today; the mesh path needs its key-routed dispatch fused in
+        # first. Fail loudly rather than insert into a mesh table with
+        # single-chip addressing (silent key loss).
+        raise NotImplementedError(
+            "preparsedIngest is not supported with meshShape yet; "
+            "unset one of them")
+
     def _save_table_state(self):
         return self.dedup
 

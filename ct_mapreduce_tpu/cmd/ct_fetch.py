@@ -96,7 +96,9 @@ def build_sink(config: CTConfig, database, backend=None):
                               backend=pem_backend,
                               device_queue_depth=config.device_queue_depth,
                               decode_workers=config.decode_workers,
-                              overlap_workers=config.overlap_workers), model
+                              overlap_workers=config.overlap_workers,
+                              preparsed=config.preparsed_ingest or None,
+                              ), model
     sink = DatabaseSink(
         database,
         cn_filters=tuple(config.issuer_cn_filters()),

@@ -32,6 +32,8 @@ class CTConfig:
     num_threads: int = 1
     decode_workers: int = 0  # 0 = auto (cpu count); raw-batch decode pool
     overlap_workers: int = 0  # >0 = overlapped ingest (decode‖device‖drain)
+    preparsed_ingest: bool = False  # host sidecar extraction + walker-free
+    # device step (CTMR_PREPARSED=1 equivalent; needs the native decoder)
     log_expired_entries: bool = False
     run_forever: bool = False
     polling_delay_mean: str = "10m"
@@ -68,6 +70,7 @@ class CTConfig:
         "numThreads": ("num_threads", int),
         "decodeWorkers": ("decode_workers", int),
         "overlapWorkers": ("overlap_workers", int),
+        "preparsedIngest": ("preparsed_ingest", bool),
         "logExpiredEntries": ("log_expired_entries", bool),
         "runForever": ("run_forever", bool),
         "pollingDelayMean": ("polling_delay_mean", str),
@@ -219,6 +222,7 @@ class CTConfig:
             "numThreads = Use this many threads for normal operations",
             "decodeWorkers = native leaf-decode threads (0 = cpu count)",
             "overlapWorkers = overlapped-ingest decode pool size (0 = serial dispatch)",
+            "preparsedIngest = host sidecar extraction + walker-free device step",
             "savePeriod = Duration between state saves, e.g. 15m",
             "logList = URLs of the CT Logs, comma delimited",
             "outputRefreshPeriod = Period between output publications",

@@ -88,15 +88,28 @@ class OverlapIngestPipeline:
         self._exc_lock = threading.Lock()
         # Bound decoded-but-unsubmitted chunks (each pins ~chunk bytes
         # twice: packed host rows + the enqueued device buffer).
-        self._prepared_sem = threading.BoundedSemaphore(
-            max_prepared or self.decode_workers + 1
-        )
+        self._max_prepared = max_prepared or self.decode_workers + 1
+        self._prepared_sem = threading.BoundedSemaphore(self._max_prepared)
         self._closed = False
         # Per-stage busy seconds (wall time spent inside the stage) —
         # the occupancy gauges bench.py reports. Busy sums exceeding
-        # the wall clock is the overlap actually happening.
-        self.busy = {"decode": 0.0, "submit": 0.0, "drain": 0.0}
+        # the wall clock is the overlap actually happening. "lock" is
+        # the submit thread's wait for the sink's dispatch lock —
+        # sampled SEPARATELY so the submit gauge (and the bench's
+        # storeCertificate-derived dispatch budget) measures submit
+        # work, not lock contention.
+        self.busy = {"decode": 0.0, "submit": 0.0, "drain": 0.0,
+                     "lock": 0.0}
         self._busy_lock = threading.Lock()
+        # Bounded-queue depth high-water marks: how full the prepared
+        # window (decoded-but-unsubmitted chunks) and the drain queue
+        # (submitted-but-unfolded batches) ever got. A decode-starved
+        # pipeline never fills the prepared window; a drain-starved one
+        # pins the drain queue at its cap — the smoke gate reads these
+        # gauges to tell the two apart.
+        self.highwater = {"prepared": 0, "drain_queue": 0}
+        self._prepared_in_use = 0
+        self._hw_lock = threading.Lock()
         self._submit_t = threading.Thread(
             target=self._submit_loop, name="ovl-submit", daemon=True)
         self._drain_t = threading.Thread(
@@ -117,10 +130,14 @@ class OverlapIngestPipeline:
             # select{failure | slot} — a dead submit loop must surface
             # as an error here, never as a hung producer.
             self._raise_if_failed()
+        with self._hw_lock:
+            self._prepared_in_use += 1
+            if self._prepared_in_use > self.highwater["prepared"]:
+                self.highwater["prepared"] = self._prepared_in_use
         try:
             fut = self._pool.submit(self._decode_one, pairs)
         except BaseException:
-            self._prepared_sem.release()
+            self._release_prepared()
             raise
         self._order_q.put(fut)
 
@@ -152,7 +169,8 @@ class OverlapIngestPipeline:
 
     def occupancy(self, wall_s: float) -> dict[str, float]:
         """Per-stage busy fraction of ``wall_s``, also published as
-        ``overlap.<stage>_occupancy`` gauges."""
+        ``overlap.<stage>_occupancy`` gauges (plus the bounded-queue
+        high-water gauges)."""
         with self._busy_lock:
             busy = dict(self.busy)
         out = {}
@@ -160,7 +178,24 @@ class OverlapIngestPipeline:
             frac = busy_s / wall_s if wall_s > 0 else 0.0
             out[stage] = frac
             metrics.set_gauge("overlap", f"{stage}_occupancy", value=frac)
+        self.publish_highwater()
         return out
+
+    def publish_highwater(self) -> dict[str, int]:
+        """Export the bounded-queue high-water marks as gauges:
+        ``overlap.prepared_highwater`` (cap ``prepared_capacity``) and
+        ``overlap.drain_queue_highwater`` (cap ``queue_depth``)."""
+        with self._hw_lock:
+            hw = dict(self.highwater)
+        metrics.set_gauge("overlap", "prepared_highwater",
+                          value=float(hw["prepared"]))
+        metrics.set_gauge("overlap", "prepared_capacity",
+                          value=float(self._max_prepared))
+        metrics.set_gauge("overlap", "drain_queue_highwater",
+                          value=float(hw["drain_queue"]))
+        metrics.set_gauge("overlap", "drain_queue_capacity",
+                          value=float(self.queue_depth))
+        return hw
 
     # -- stage bodies ----------------------------------------------------
     def _decode_one(self, pairs):
@@ -182,25 +217,41 @@ class OverlapIngestPipeline:
             try:
                 prep = item.result()
             except BaseException as err:
-                self._prepared_sem.release()
+                self._release_prepared()
                 self._fail(err)
                 continue  # keep consuming so close()/drain_all() return
             if self._failed.is_set():
-                self._prepared_sem.release()
+                self._release_prepared()
                 continue
-            t0 = time.perf_counter()
+            # Dispatch-lock wait is sampled SEPARATELY from the
+            # storeCertificate envelope (its own busy bucket + the
+            # dispatchLockWait sample): lock contention is not submit
+            # work, and folding it in overstated the submit occupancy
+            # gauge / the bench's e2e dispatch budget.
+            t_lock = time.perf_counter()
             try:
-                with self._sink._dispatch_lock, metrics.measure(
-                        "ct-fetch", "storeCertificate"):
-                    work = self._sink._submit_chunk(prep)
+                with self._sink._dispatch_lock:
+                    lock_s = time.perf_counter() - t_lock
+                    self._add_busy("lock", lock_s)
+                    metrics.add_sample("ct-fetch", "dispatchLockWait",
+                                       value=lock_s)
+                    t0 = time.perf_counter()
+                    try:
+                        with metrics.measure("ct-fetch", "storeCertificate"):
+                            work = self._sink._submit_chunk(prep)
+                    finally:
+                        self._add_busy("submit", time.perf_counter() - t0)
             except BaseException as err:
                 self._fail(err)
                 continue
             finally:
-                self._prepared_sem.release()
-                self._add_busy("submit", time.perf_counter() - t0)
+                self._release_prepared()
             for kind, payload, der_of in work:
                 self._drain_q.put((kind, payload, der_of))
+                depth = self._drain_q.qsize()
+                with self._hw_lock:
+                    if depth > self.highwater["drain_queue"]:
+                        self.highwater["drain_queue"] = depth
 
     def _drain_loop(self) -> None:
         while True:
@@ -223,6 +274,11 @@ class OverlapIngestPipeline:
                 self._add_busy("drain", time.perf_counter() - t0)
 
     # -- shared plumbing -------------------------------------------------
+    def _release_prepared(self) -> None:
+        with self._hw_lock:
+            self._prepared_in_use -= 1
+        self._prepared_sem.release()
+
     def _add_busy(self, stage: str, seconds: float) -> None:
         with self._busy_lock:
             self.busy[stage] += seconds

@@ -130,7 +130,7 @@ class AggregatorSink:
 
     def __init__(self, aggregator, flush_size: int = 4096, backend=None,
                  device_queue_depth: int = 2, decode_workers: int = 0,
-                 overlap_workers: int = 0):
+                 overlap_workers: int = 0, preparsed: Optional[bool] = None):
         self.aggregator = aggregator
         self.flush_size = flush_size
         # Optional durable backend (certPath): first-seen certs get the
@@ -165,6 +165,20 @@ class AggregatorSink:
         # caller-thread decode→submit→drain sequence above. Exact
         # same decode/submit/complete primitives, so results are
         # parity-identical; only the threading changes.
+        # Pre-parsed ingest lane (CTMR_PREPARSED=1 / preparsedIngest
+        # directive): the native decoder's sidecar extraction replaces
+        # the on-device DER walk — the device step runs fingerprint +
+        # insert + counts on ~59 B/lane of compact inputs, row bytes
+        # never ship, and the readback is the compact bitmask/flag-id
+        # form. Lanes the extractor flags undecidable (sidecar.ok == 0)
+        # replay through the device-walker path, so the two lanes stay
+        # parity-exact including host-lane spill counts. Requires the
+        # native library; silently stays on the walker lane without it.
+        if preparsed is None:
+            import os
+
+            preparsed = os.environ.get("CTMR_PREPARSED", "0") == "1"
+        self.preparsed = bool(preparsed)
         self.overlap_workers = max(0, int(overlap_workers))
         self._overlap = None
         if self.overlap_workers:
@@ -209,14 +223,24 @@ class AggregatorSink:
             self._overlap.submit_chunk(pairs)
             return
         prep = self._prepare_chunk(pairs)
-        with self._dispatch_lock, metrics.measure("ct-fetch",
-                                                  "storeCertificate"):
-            for item in self._submit_chunk(prep):
-                if item[0] == "pending":
-                    self._inflight.append((item[1], item[2]))
-                else:  # oversized-lane result: fold PEMs immediately
-                    self._store_pems(item[1], item[2])
-            self._drain_inflight(self.device_queue_depth)
+        t_lock = time.monotonic()
+        with self._dispatch_lock:
+            # Lock wait sampled apart from the storeCertificate
+            # envelope (see ingest/overlap.py's submit loop): multiple
+            # store workers contend here, and the wait is not submit
+            # work.
+            metrics.add_sample("ct-fetch", "dispatchLockWait",
+                               value=time.monotonic() - t_lock)
+            with metrics.measure("ct-fetch", "storeCertificate"):
+                self._dispatch_prepared(prep)
+
+    def _dispatch_prepared(self, prep: "_PreparedChunk") -> None:
+        for item in self._submit_chunk(prep):
+            if item[0] == "pending":
+                self._inflight.append((item[1], item[2]))
+            else:  # oversized-lane result: fold PEMs immediately
+                self._store_pems(item[1], item[2])
+        self._drain_inflight(self.device_queue_depth)
 
     def _prepare_chunk(self, pairs: list[tuple[str, str]]) -> "_PreparedChunk":
         """Stage 1 — decode + pack + H2D submit, NO aggregator-state
@@ -328,6 +352,25 @@ class AggregatorSink:
             else:
                 oversized.append((e.cert_der, e.issuer_der))
 
+        # Pre-parsed lane: extract walker-exact sidecars on the host
+        # (one more native pass over the just-packed rows — cache-warm)
+        # and split undecidable lanes out for the device-walker replay.
+        sidecar = None
+        walker_fallback: list[tuple[bytes, bytes]] = []
+        if self.preparsed:
+            sidecar = leafpack.extract_sidecars(data, dec.length)
+            if sidecar is not None:
+                pre_ok = sidecar.ok.astype(bool)
+                for i in np.nonzero(valid & ~pre_ok)[0]:
+                    # Rare walker-undecidable lane: replay through the
+                    # device-walker path (aggregator.ingest), exactly
+                    # what the default lane would do with it.
+                    walker_fallback.append((
+                        data[i, : dec.length[i]].tobytes(),
+                        dec.group_issuers[int(dec.issuer_group[i])],
+                    ))
+                valid = valid & pre_ok
+
         # Start the H2D transfer of the big byte rows BEFORE taking the
         # dispatch lock: device_put enqueues asynchronously, so the
         # transfer of batch N+1 overlaps the device step of batch N
@@ -336,9 +379,11 @@ class AggregatorSink:
         # host-side — the aggregator reads them for bookkeeping. Tail
         # chunks (not a multiple of the compiled batch shape) take the
         # NumPy path: their padding copy happens host-side in the
-        # aggregator.
+        # aggregator. The pre-parsed lane never transfers rows at all
+        # (its device inputs are the compact per-lane fields).
         data_host = data
-        if valid.any() and data.shape[0] % self.aggregator.batch_size == 0:
+        if (sidecar is None and valid.any()
+                and data.shape[0] % self.aggregator.batch_size == 0):
             import jax
 
             # Timing note: device_put ENQUEUES asynchronously, so this
@@ -349,7 +394,8 @@ class AggregatorSink:
         return _PreparedChunk(
             data=data, host_data=data_host, length=dec.length,
             issuer_idx=issuer_idx, valid=valid, dec=dec,
-            oversized=oversized,
+            oversized=oversized, sidecar=sidecar,
+            walker_fallback=walker_fallback,
         )
 
     def _submit_chunk(self, prep: "_PreparedChunk") -> list[tuple]:
@@ -362,14 +408,26 @@ class AggregatorSink:
         complete) that only need PEM folding."""
         items: list[tuple] = []
         if prep.valid.any():
-            pending = self.aggregator.ingest_packed_submit(
-                prep.data, prep.length, prep.issuer_idx, prep.valid,
-                host_data=prep.host_data,
-            )
+            if prep.sidecar is not None:
+                pending = self.aggregator.ingest_preparsed_submit(
+                    prep.sidecar, prep.issuer_idx, prep.valid,
+                    prep.host_data, prep.length,
+                )
+            else:
+                pending = self.aggregator.ingest_packed_submit(
+                    prep.data, prep.length, prep.issuer_idx, prep.valid,
+                    host_data=prep.host_data,
+                )
             dec = prep.dec
             items.append((
                 "pending", pending,
                 lambda pos, _d=dec: _d.data[pos, : _d.length[pos]].tobytes(),
+            ))
+        if prep.walker_fallback:
+            fb = prep.walker_fallback
+            res_fb = self.aggregator.ingest(fb)
+            items.append((
+                "result", res_fb, lambda pos, _o=fb: _o[pos][0],
             ))
         if prep.oversized:
             oversized = prep.oversized
@@ -379,7 +437,8 @@ class AggregatorSink:
             ))
         metrics.incr_counter(
             "ct-fetch", "insertCertificate",
-            value=float(int(prep.valid.sum()) + len(prep.oversized)),
+            value=float(int(prep.valid.sum()) + len(prep.oversized)
+                        + len(prep.walker_fallback)),
         )
         return items
 
@@ -420,9 +479,12 @@ class AggregatorSink:
         # other and flush-path completes must not skew it. (In overlap
         # mode completes are NOT nested — they run on the drain thread
         # — and the bench computes the budget accordingly.)
-        with self._dispatch_lock, metrics.measure("ct-fetch",
-                                                  "storeCertificate"):
-            self._drain_inflight(0)
+        t_lock = time.monotonic()
+        with self._dispatch_lock:
+            metrics.add_sample("ct-fetch", "dispatchLockWait",
+                               value=time.monotonic() - t_lock)
+            with metrics.measure("ct-fetch", "storeCertificate"):
+                self._drain_inflight(0)
 
     def close(self) -> None:
         """Flush, then stop the overlap scheduler's threads (no-op in
@@ -505,6 +567,9 @@ class _PreparedChunk:
     valid: np.ndarray  # bool[n]
     dec: object  # the DecodedBatch (host rows for PEM der_of slicing)
     oversized: list  # [(cert_der, issuer_der)] exact-lane entries
+    sidecar: object = None  # leafpack.Sidecar — pre-parsed lane active
+    walker_fallback: list = field(default_factory=list)  # sidecar-
+    # undecidable lanes, replayed through the device-walker path
 
 
 @dataclass

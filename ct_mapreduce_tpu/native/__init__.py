@@ -88,6 +88,19 @@ def load() -> Optional[ctypes.CDLL]:
             i32p,
             u8p, ctypes.c_int64,
         ]
+        lib.ctmr_extract_sidecars.restype = None
+        lib.ctmr_extract_sidecars.argtypes = [
+            ctypes.c_int64,
+            u8p, ctypes.c_int64, i32p,
+            u8p,
+            i32p, i32p,
+            i32p,
+            u8p, u8p,
+            i32p, i32p,
+            i32p, i32p,
+            i32p, i32p,
+            i32p, i32p,
+        ]
         lib.ctmr_pack_ders.restype = ctypes.c_int64
         lib.ctmr_pack_ders.argtypes = [
             ctypes.c_int64,

@@ -63,6 +63,84 @@ class DecodedBatch:
         return self.status == OK
 
 
+@dataclass
+class Sidecar:
+    """Per-lane pre-parsed identity fields for a packed batch — the
+    host half of the pre-parsed ingest lane.
+
+    Extracted by the native scalar port of the device DER walker
+    (``ctmr_extract_sidecars``), so semantics are bit-exact with
+    :func:`ct_mapreduce_tpu.ops.der_kernel.parse_certs` on every lane:
+    ``ok == 0`` means the walker itself would reject the lane (it
+    falls back to the device-walker path), and on ``ok`` lanes every
+    field equals the walker's output (pinned by
+    tests/test_preparsed.py's mutation fuzz). All arrays length n;
+    offsets index into the packed row (cert DER at offset 0).
+    """
+
+    ok: np.ndarray  # uint8[n] — 0: route through the device walker
+    serial_off: np.ndarray  # int32[n]
+    serial_len: np.ndarray  # int32[n]
+    not_after_hour: np.ndarray  # int32[n] epoch-hour bucket
+    is_ca: np.ndarray  # uint8[n]
+    has_crldp: np.ndarray  # uint8[n]
+    cn_off: np.ndarray  # int32[n] — first issuer-CN value window
+    cn_len: np.ndarray  # int32[n] (0 = no CN found)
+    issuer_off: np.ndarray  # int32[n] — full issuer Name TLV
+    issuer_len: np.ndarray  # int32[n]
+    spki_off: np.ndarray  # int32[n]
+    spki_len: np.ndarray  # int32[n]
+    crldp_off: np.ndarray  # int32[n] — CRLDP extnValue content window
+    crldp_len: np.ndarray  # int32[n]
+
+
+def extract_sidecars(data: np.ndarray,
+                     length: np.ndarray) -> Optional[Sidecar]:
+    """Pre-parsed sidecars for packed rows ``uint8[n, pad]`` +
+    ``int32[n]`` lengths, or None when the native library is
+    unavailable (callers then stay on the device-walker lane —
+    there is deliberately no Python fallback: the contract is
+    walker-exactness, and the walker itself is always available)."""
+    import os
+
+    if os.environ.get("CTMR_NATIVE", "1") == "0":
+        return None
+    lib = load_native()
+    if lib is None:
+        return None
+    n = int(data.shape[0])
+    data = np.ascontiguousarray(data, np.uint8)
+    length = np.ascontiguousarray(length, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    out_u8 = [np.zeros((n,), np.uint8) for _ in range(3)]
+    out_i32 = [np.zeros((n,), np.int32) for _ in range(11)]
+    ok, is_ca, has_crldp = out_u8
+    (serial_off, serial_len, not_after_hour, cn_off, cn_len,
+     issuer_off, issuer_len, spki_off, spki_len,
+     crldp_off, crldp_len) = out_i32
+    lib.ctmr_extract_sidecars(
+        n, data.ctypes.data_as(u8p), data.shape[1],
+        length.ctypes.data_as(i32p),
+        ok.ctypes.data_as(u8p),
+        serial_off.ctypes.data_as(i32p), serial_len.ctypes.data_as(i32p),
+        not_after_hour.ctypes.data_as(i32p),
+        is_ca.ctypes.data_as(u8p), has_crldp.ctypes.data_as(u8p),
+        cn_off.ctypes.data_as(i32p), cn_len.ctypes.data_as(i32p),
+        issuer_off.ctypes.data_as(i32p), issuer_len.ctypes.data_as(i32p),
+        spki_off.ctypes.data_as(i32p), spki_len.ctypes.data_as(i32p),
+        crldp_off.ctypes.data_as(i32p), crldp_len.ctypes.data_as(i32p),
+    )
+    return Sidecar(
+        ok=ok, serial_off=serial_off, serial_len=serial_len,
+        not_after_hour=not_after_hour, is_ca=is_ca, has_crldp=has_crldp,
+        cn_off=cn_off, cn_len=cn_len,
+        issuer_off=issuer_off, issuer_len=issuer_len,
+        spki_off=spki_off, spki_len=spki_len,
+        crldp_off=crldp_off, crldp_len=crldp_len,
+    )
+
+
 def _assign_gid(gid_of: dict, group_issuers: list, der: bytes) -> int:
     """Accumulating DER→group-id assignment (shared by every producer
     that merges issuer groups)."""

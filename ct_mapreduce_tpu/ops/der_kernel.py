@@ -756,9 +756,16 @@ def parse_certs_rows(
     # reading zeros there would silently classify the lane as
     # "no extensions".
     in_win = a + d + 11 <= w4
-    ok &= in_win | ((p + d) >= tbs_end)
     tag, clen, hlen, hok = _read_header_w(win, a, d, p, tbs_end)
     has_ext = hok & (tag == 0xA3) & ((p + d) < tbs_end) & in_win
+    # ANY trailing TBS bytes that are not a well-formed in-window [3]
+    # frame route the lane to the exact host lane: the host parser
+    # scans PAST frames it doesn't recognize (and tolerates a [3]
+    # frame whose length overruns the TBS while its inner list is
+    # intact), so silently deciding "no extensions" here would
+    # mis-extract is_ca/CRLDP on exactly those certs (caught by the
+    # round-7 sidecar/host mutation fuzz).
+    ok &= has_ext | ((p + d) >= tbs_end)
     de = d + hlen
     etag, eclen, ehlen, eok = _read_header_w(win, a, de, p, tbs_end)
     ext_listed = has_ext & eok & (etag == 0x30)

@@ -325,12 +325,133 @@ def ingest_core(
     )
 
 
+# -- pre-parsed ingest lane ---------------------------------------------
+#
+# When the native decoder has already extracted the identity fields on
+# the host (native/ctmr_native.cpp ctmr_extract_sidecars — a scalar
+# port of the device walker), the device step collapses to its
+# arithmetic floor: fingerprint SHA-256 + dedup-table insert +
+# per-issuer counts. No row bytes ship to the device at all (~59 B of
+# compact inputs per lane instead of 1-2 KB of padded DER), the
+# word-pack and the DER walker (~107 of the walker step's ~194
+# ns/entry per the round-5 cost model) disappear, and the readback is
+# COMPACT: a was-unknown bitmask (1 bit/lane), sort-compacted
+# probe-overflow lane indices (O(flagged), not O(batch)), and the
+# count vectors — packed into ONE int32 array so the tunneled stack's
+# per-execution readback toll is paid once per dispatch.
+#
+# Every filter/routing predicate that doesn't depend on table state
+# (CA/expired/CN filters, the device-exactness gates) is a pure
+# function of the sidecar and is evaluated by the HOST
+# (agg/aggregator.py ingest_preparsed_submit) with arithmetic
+# mirroring local_lanes exactly; only `insertable` reaches the device.
+
+N_PREPARSED_FLAG_CAP = 1024  # default compacted-overflow capacity
+
+
+class PreparsedStepOut(NamedTuple):
+    """Device outputs of the pre-parsed step, readback-oriented."""
+
+    packed: jax.Array  # int32[K, 2 + nb + flag_cap + num_issuers] — the
+    # ONE array the host reads per dispatch; per chunk row:
+    #   [0] unknown_count, [1] overflow_count,
+    #   [2 : 2+nb] was-unknown bitmask (bit i of word w = lane w*32+i),
+    #   [2+nb : 2+nb+flag_cap] overflow lane ids ascending (B = none),
+    #   [2+nb+flag_cap :] per-issuer fresh-insert counts.
+    overflow_bits: jax.Array  # uint32[K, nb] — full overflow bitmask,
+    # fetched ONLY when overflow_count exceeds flag_cap (spill).
+
+
+def _pack_bits(flags: jax.Array, nb: int) -> jax.Array:
+    """bool[B] → uint32[nb] bitmask (bit i of word w = lane w*32+i)."""
+    b = flags.shape[0]
+    padded = jnp.pad(flags, (0, nb * 32 - b)).reshape(nb, 32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, :]
+    return jnp.sum(jnp.where(padded, weights, jnp.uint32(0)), axis=1)
+
+
+def preparsed_core(
+    table,
+    serials: jax.Array,  # uint8[K, B, MAX_SERIAL_BYTES]
+    serial_len: jax.Array,  # int32[K, B]
+    not_after_hour: jax.Array,  # int32[K, B]
+    issuer_idx: jax.Array,  # int32[K, B]
+    insertable: jax.Array,  # bool[K, B] — host-computed gate
+    base_hour: jax.Array,  # int32 scalar
+    num_issuers: int = packing.MAX_ISSUERS,
+    max_probes: int = 32,
+    flag_cap: int = N_PREPARSED_FLAG_CAP,
+):
+    """Fused multi-chunk pre-parsed step: ONE device execution for K
+    resident chunks (fori_loop, like the aggregator's reinsert path) —
+    on the tunneled stack every execution charges ~0.2 s on its first
+    later D2H read, so chunked dispatch loops would pay it K times."""
+    k_chunks, b = serial_len.shape
+    nb = -(-b // 32)
+    width = 2 + nb + flag_cap + num_issuers
+    packed0 = jnp.zeros((k_chunks, width), jnp.int32)
+    ovf_bits0 = jnp.zeros((k_chunks, nb), jnp.uint32)
+
+    def body(k, carry):
+        table, packed, ovf_bits = carry
+        fps = fingerprints(
+            issuer_idx[k], not_after_hour[k], serials[k], serial_len[k]
+        )
+        hour_off = not_after_hour[k] - base_hour
+        meta = (
+            (issuer_idx[k].astype(jnp.uint32) << packing.META_HOUR_BITS)
+            | jnp.clip(hour_off, 0, packing.META_HOUR_SPAN - 1).astype(
+                jnp.uint32)
+        )
+        table, wu, ovf = table_insert(
+            table, fps, meta, insertable[k], max_probes=max_probes
+        )
+        counts = jnp.zeros((num_issuers,), jnp.int32).at[issuer_idx[k]].add(
+            wu.astype(jnp.int32), mode="drop"
+        )
+        iota = jnp.arange(b, dtype=jnp.int32)
+        ovf_idx = jnp.sort(jnp.where(ovf, iota, b))[:flag_cap]
+        if flag_cap > b:  # tiny chunks: keep the packed width static
+            ovf_idx = jnp.pad(ovf_idx, (0, flag_cap - b),
+                              constant_values=b)
+        row = jnp.concatenate([
+            jnp.stack([wu.sum(dtype=jnp.int32), ovf.sum(dtype=jnp.int32)]),
+            jax.lax.bitcast_convert_type(_pack_bits(wu, nb), jnp.int32),
+            ovf_idx,
+            counts,
+        ])
+        return (
+            table,
+            packed.at[k].set(row),
+            ovf_bits.at[k].set(_pack_bits(ovf, nb)),
+        )
+
+    table, packed, ovf_bits = jax.lax.fori_loop(
+        0, k_chunks, body, (table, packed0, ovf_bits0)
+    )
+    return table, PreparsedStepOut(packed=packed, overflow_bits=ovf_bits)
+
+
 # The production entry point: donated table state, cached per shape.
 ingest_step = functools.partial(
     jax.jit,
     static_argnames=("num_issuers", "max_probes"),
     donate_argnums=(0,),
 )(ingest_core)
+
+# Pre-parsed lane entry points (donating and not: CPU's XLA can't
+# alias the donated layouts and warns per dispatch, so the aggregator
+# picks by backend exactly like the walker-lane pair below).
+ingest_step_preparsed = functools.partial(
+    jax.jit,
+    static_argnames=("num_issuers", "max_probes", "flag_cap"),
+)(preparsed_core)
+
+ingest_step_preparsed_donated = functools.partial(
+    jax.jit,
+    static_argnames=("num_issuers", "max_probes", "flag_cap"),
+    donate_argnums=(0,),
+)(preparsed_core)
 
 # Overlapped-ingest entry point: donates the packed row buffer too.
 # The overlap scheduler hands the step a device-resident batch it will

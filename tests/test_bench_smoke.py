@@ -35,3 +35,13 @@ def test_bench_smoke_overlap_gate(monkeypatch):
     # The stage budget really was measured (not zeroed by a silent
     # metrics-sink regression).
     assert out["smoke_decode_s"] > 0 and out["smoke_device_wait_s"] > 0
+    # Pre-parsed leg: run_smoke itself asserts exact parity with the
+    # walker lanes AND that D2H flag traffic stays O(flagged); here we
+    # only pin that the leg ran when the native extractor exists (its
+    # absence would silently drop the gate).
+    from ct_mapreduce_tpu.native import available
+
+    if available():
+        assert out["smoke_preparsed_flag_bytes"] > 0
+        # Far below one int32 status row per chunk (the old readback).
+        assert out["smoke_preparsed_flag_bytes"] < 4 * out["smoke_entries"]

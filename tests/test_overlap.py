@@ -236,3 +236,68 @@ def test_issuer_too_long_status_skips_futile_redecode():
     assert pads_seen == [sink.PAD_LEN // 2], pads_seen
     # ... and the oversized-issuer entry still counted, exactly once.
     assert agg.drain().total == len(small) + 1
+
+
+def test_overlap_queue_highwater_gauges():
+    """The bounded-queue high-water marks (prepared window + drain
+    queue) are tracked and exported as gauges — the smoke gate's
+    handle for telling a decode-starved pipeline from a drain-starved
+    one."""
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
+    agg, sink = make_sink(overlap_workers=2, depth=2)
+    ovl = sink._overlap
+    for i in range(6):
+        sink.store_raw_batch(wire_batch(i * 32, 32))
+    ovl.drain_all()
+    hw = ovl.publish_highwater()
+    cap_prepared, cap_drain = ovl._max_prepared, ovl.queue_depth
+    sink.close()
+    assert 1 <= hw["prepared"] <= cap_prepared
+    assert 0 <= hw["drain_queue"] <= cap_drain
+    # Gauges really were exported through the metrics API.
+    sink_metrics2 = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink_metrics2)
+    try:
+        agg2, sink2 = make_sink(overlap_workers=2, depth=1)
+        ovl2 = sink2._overlap
+        for i in range(4):
+            sink2.store_raw_batch(wire_batch(i * 32, 32))
+        ovl2.drain_all()
+        occ = ovl2.occupancy(1.0)
+        sink2.close()
+    finally:
+        tmetrics.set_sink(prev)
+    gauges = sink_metrics2.snapshot()["gauges"]
+    for key in ("overlap.prepared_highwater", "overlap.prepared_capacity",
+                "overlap.drain_queue_highwater",
+                "overlap.drain_queue_capacity"):
+        assert key in gauges, sorted(gauges)
+    assert gauges["overlap.prepared_highwater"] >= 1
+    assert "lock" in occ  # dispatch-lock wait is its own occupancy bucket
+
+
+def test_overlap_lock_wait_sampled_outside_store_envelope():
+    """dispatchLockWait is its own sample and the storeCertificate
+    envelope opens only after the lock is held — the bench's submit
+    budget must not fold lock contention into submit cost."""
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
+    sink_metrics = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink_metrics)
+    try:
+        agg, sink = make_sink(overlap_workers=2, depth=2)
+        for i in range(4):
+            sink.store_raw_batch(wire_batch(i * 32, 32))
+        sink.close()
+    finally:
+        tmetrics.set_sink(prev)
+    samples = sink_metrics.snapshot()["samples"]
+    assert "ct-fetch.dispatchLockWait" in samples
+    assert "ct-fetch.storeCertificate" in samples
+    # One lock sample per submitted chunk (4 chunks + flush barrier
+    # paths), all non-negative.
+    assert samples["ct-fetch.dispatchLockWait"]["count"] >= 4
+    assert samples["ct-fetch.dispatchLockWait"]["min"] >= 0.0

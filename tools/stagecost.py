@@ -17,6 +17,10 @@ device-value read. Stage deltas then give real per-stage costs:
   sha     — + fingerprint block build + SHA-256
   lanes   — the full communication-free prefix (local_lanes)
   full    — ingest_core (adds the dedup-table insert, donated state)
+  preparsed — the pre-parsed lane's whole device step (fingerprint +
+            insert + compact readback from host-extracted sidecars;
+            compare against `full` — the delta is what the host-side
+            sidecar extraction buys the device)
 
 Run:  python tools/stagecost.py [batch] [stage ...]
 """
@@ -206,6 +210,67 @@ def main() -> None:
             f"{dt / batch * 1e9:8.1f} ns/entry  ({n} sweeps)")
         return dt
 
+    def run_preparsed():
+        """The walker-free step, timed with the headline methodology:
+        host-shaped compact inputs resident on device, the serial
+        epoch window restamped per sweep (unique identities, all-fresh
+        inserts), one fori_loop execution per chunk, synchronous value
+        read. Its rate vs `full` is the pre-parsed lane's device-side
+        win (the ISSUE-7 acceptance gate runs exactly this on CPU)."""
+        rows = np.asarray(datas[0] if hasattr(datas, "shape") else datas[0])
+        rows = np.asarray(rows, np.uint8)
+        s = packing.MAX_SERIAL_BYTES
+        cols = tpl.serial_off + np.arange(s)
+        serials0 = rows[:, cols].copy()
+        serials0[:, tpl.serial_len:] = 0
+        serials_s = serials0[None]  # [K=1, B, 46]
+        slen = np.full((1, batch), tpl.serial_len, np.int32)
+        nah = np.full((1, batch), packing.DEFAULT_BASE_HOUR + 1000,
+                      np.int32)
+        iidx = np.zeros((1, batch), np.int32)
+        ins = np.ones((1, batch), bool)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def mega(table, acc, n_sweeps, serials, slen, nah, iidx, ins):
+            def body(sw, carry):
+                table, acc = carry
+                e = acc + jnp.uint32(sw) + jnp.uint32(1)
+                eb = jnp.stack(
+                    [(e >> 24) & 0xFF, (e >> 16) & 0xFF, (e >> 8) & 0xFF,
+                     e & 0xFF]).astype(jnp.uint8)
+                # Epoch window at serial bytes 4..8 (the headline's
+                # schema); the lane counter sits in the last 4 bytes.
+                sers = serials.at[:, :, 4:8].set(eb[None, None, :])
+                table, out = pipeline.preparsed_core(
+                    table, sers, slen, nah, iidx, ins,
+                    jnp.int32(packing.DEFAULT_BASE_HOUR))
+                return table, acc + out.packed[:, 0].sum().astype(jnp.uint32)
+            return jax.lax.fori_loop(0, n_sweeps, body, (table, acc))
+
+        fetch = jax.jit(lambda a: a + jnp.uint32(0))
+        table = mk_table(cap)
+        acc = jax.device_put(np.uint32(0))
+        t0 = time.perf_counter()
+        table, acc = mega(table, acc, np.int32(1), serials_s, slen, nah,
+                          iidx, ins)
+        int(fetch(acc))
+        say(f"  preparsed: compile+warmup {time.perf_counter() - t0:.1f}s")
+        t0 = time.perf_counter()
+        table, acc = mega(table, acc, np.int32(1), serials_s, slen, nah,
+                          iidx, ins)
+        int(fetch(acc))
+        per_sweep = max(time.perf_counter() - t0, 1e-4)
+        budget = max(1, int(cap * 0.5) // batch - 3)
+        n = max(2, min(int(exec_target_s / per_sweep), budget, 200))
+        t0 = time.perf_counter()
+        table, acc = mega(table, acc, np.int32(n), serials_s, slen, nah,
+                          iidx, ins)
+        int(fetch(acc))
+        dt = (time.perf_counter() - t0) / n
+        say(f"{'prepar.':7s} {dt * 1e3:9.2f} ms/sweep  "
+            f"{dt / batch * 1e9:8.1f} ns/entry  ({n} sweeps)")
+        return dt
+
     stages = [
         ("read", s_read), ("pack", s_pack), ("pack2", s_pack2),
         ("parse", s_parse),
@@ -218,6 +283,8 @@ def main() -> None:
         results[name] = run_stage(name, fn)
     if not only or "full" in only:
         results["full"] = run_full()
+    if not only or "preparsed" in only:
+        results["preparsed"] = run_preparsed()
 
     order = [n for n, _ in stages] + ["full"]
     got = [n for n in order if n in results]
@@ -228,6 +295,12 @@ def main() -> None:
         d = results[n] - prev
         say(f"  +{n:7s} {d * 1e3:9.2f} ms  {d / batch * 1e9:8.1f} ns/entry")
         prev = results[n]
+    if "preparsed" in results and "full" in results:
+        f, pp = results["full"], results["preparsed"]
+        say("")
+        say(f"preparsed step vs walker step: {pp / batch * 1e9:.1f} vs "
+            f"{f / batch * 1e9:.1f} ns/entry "
+            f"({'WIN' if pp < f else 'LOSS'}, {f / max(pp, 1e-12):.2f}x)")
 
 
 if __name__ == "__main__":
