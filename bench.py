@@ -933,8 +933,22 @@ def run_smoke() -> dict:
         raw_batches.append(RawBatch(lis, eds, i * chunk, "smoke-log"))
     capacity = 1 << max(14, (2 * total).bit_length())
 
-    def replay(overlap: int, depth: int, preparsed: bool = False):
-        agg = TpuAggregator(capacity=capacity, batch_size=chunk)
+    def replay(overlap: int, depth: int, preparsed: bool = False,
+               sharded: bool = False):
+        if sharded:
+            import jax as _jax
+            from jax.sharding import Mesh
+
+            from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
+
+            n_dev = len(_jax.devices())
+            while n_dev > 1 and chunk % n_dev:
+                n_dev -= 1
+            mesh = Mesh(np.array(_jax.devices()[:n_dev]), ("shard",))
+            agg = ShardedAggregator(mesh, capacity=capacity,
+                                    batch_size=chunk)
+        else:
+            agg = TpuAggregator(capacity=capacity, batch_size=chunk)
         sink = AggregatorSink(agg, flush_size=chunk,
                               device_queue_depth=depth,
                               overlap_workers=overlap,
@@ -975,7 +989,9 @@ def run_smoke() -> dict:
             "device_wait_s": (busy["submit"] + busy["drain"]
                               if busy else s("completeBatch")),
             "drain_s": drain_s,
-            "table_count": int(np.asarray(agg.table.count)),
+            # Via the fill hook: TpuAggregator reads table.count, the
+            # sharded leg sums its per-shard counts.
+            "table_count": agg._table_fill_exact(),
             "host_lane": agg.metrics["host_lane"],
             "flag_bytes": counters.get("ingest.d2h_flag_bytes", 0.0),
         }
@@ -1112,8 +1128,61 @@ def run_smoke() -> dict:
                 f"smoke compact readback: flag bytes {pre['flag_bytes']:.0f}"
                 f" >= one int32 status row per chunk "
                 f"({4 * chunk * n_chunks}) — readback regressed to O(batch)")
+
+        # (2c) sharded pre-parsed leg: the SAME stream through
+        # ShardedAggregator's host-routed pre-parsed step (fingerprint
+        # home shards computed in numpy, no all_to_all). Parity must be
+        # exact against the serial walker lane, and the compact-flag
+        # budget is unchanged (the reassembled readback keeps the
+        # per-chunk O(flagged) layout).
+        shp = replay(overlap=0, depth=0, preparsed=True, sharded=True)
+        log(f"smoke sharded-preparsed: wall={shp['wall']:.3f}s "
+            f"table={shp['table_count']} host_lane={shp['host_lane']} "
+            f"flag_bytes={shp['flag_bytes']:.0f}")
+        if shp["table_count"] != serial["table_count"]:
+            raise BenchError(
+                f"smoke parity: table_count sharded-preparsed "
+                f"{shp['table_count']} != serial {serial['table_count']}")
+        if shp["host_lane"] != serial["host_lane"]:
+            raise BenchError(
+                f"smoke parity: host_lane sharded-preparsed "
+                f"{shp['host_lane']} != serial {serial['host_lane']}")
+        if shp["snap"].counts != serial["snap"].counts:
+            raise BenchError(
+                "smoke parity: sharded-preparsed drained counts differ")
+        if not (0 < shp["flag_bytes"] <= flag_budget):
+            raise BenchError(
+                f"smoke compact readback (sharded): flag bytes "
+                f"{shp['flag_bytes']:.0f} outside (0, {flag_budget}] — "
+                "flag traffic is not O(flagged)")
+
+        # (2d) intra-chunk decode-thread parity: the native worker
+        # pool's threads>1 decode + sidecar extraction must be
+        # byte-exact vs threads=1 on real wire bytes.
+        from ct_mapreduce_tpu.native import leafpack
+
+        lis0, eds0 = raw_batches[0].leaf_inputs, raw_batches[0].extra_datas
+        d_1 = leafpack.decode_raw_batch(lis0, eds0, 1024, threads=1)
+        d_n = leafpack.decode_raw_batch(lis0, eds0, 1024, threads=4)
+        for fld in ("data", "length", "timestamp_ms", "entry_type",
+                    "status", "issuer_group"):
+            if not np.array_equal(getattr(d_1, fld), getattr(d_n, fld)):
+                raise BenchError(
+                    f"smoke decode-threads parity: {fld} differs "
+                    "between threads=1 and threads=4")
+        if d_1.group_issuers != d_n.group_issuers:
+            raise BenchError(
+                "smoke decode-threads parity: issuer groups differ")
+        s_1 = leafpack.extract_sidecars(d_1.data, d_1.length, threads=1)
+        s_n = leafpack.extract_sidecars(d_1.data, d_1.length, threads=4)
+        for fld in vars(s_1):
+            if not np.array_equal(getattr(s_1, fld), getattr(s_n, fld)):
+                raise BenchError(
+                    f"smoke decode-threads parity: sidecar {fld} differs")
+        log("smoke decode-threads leg: threads=4 byte-exact vs threads=1 "
+            f"({len(lis0)} wire entries)")
     else:
-        pre = None
+        pre = shp = None
         log("smoke preparsed leg skipped: native library unavailable")
 
     # (3) the overlap inequality, on the overlapped run itself.
@@ -1141,8 +1210,12 @@ def run_smoke() -> dict:
         "smoke_overlap_ratio": round(ratio, 3),
         "smoke_table_count": over["table_count"],
         **({"smoke_preparsed_wall_s": round(pre["wall"], 3),
-            "smoke_preparsed_flag_bytes": int(pre["flag_bytes"])}
+            "smoke_preparsed_flag_bytes": int(pre["flag_bytes"]),
+            "smoke_decode_threads_parity": 1}
            if pre is not None else {}),
+        **({"smoke_sharded_preparsed_wall_s": round(shp["wall"], 3),
+            "smoke_sharded_preparsed_flag_bytes": int(shp["flag_bytes"])}
+           if shp is not None else {}),
     }
 
 

@@ -83,6 +83,16 @@ class ShardedStepOut(NamedTuple):
     # aggregator's `dispatch_spill` metric so routing skew is observable)
 
 
+def shard_of_np(keys: np.ndarray, n_shards: int) -> np.ndarray:
+    """Host mirror of :func:`_shard_of` (uint32 wraparound arithmetic):
+    home shard per fingerprint row ``uint32[n, 4]``. Shared by the
+    checkpoint-restore router (`bulk_insert_np`) and the pre-parsed
+    lane's host-side routing."""
+    k = np.asarray(keys).astype(np.uint32)
+    h = k[:, 2] ^ (k[:, 3] * np.uint32(0x85EBCA6B))
+    return (h % np.uint32(n_shards)).astype(np.int32)
+
+
 def _shard_of(keys: jax.Array, n_shards: int) -> jax.Array:
     """Home shard of each fingerprint — independent bits from the slot
     hash so shard routing doesn't correlate with in-shard probing.
@@ -321,6 +331,69 @@ def _local_step(
     )
 
 
+def _local_preparsed_step(
+    table_rows, table_count,
+    serials, serial_len, not_after_hour, issuer_idx, insertable,
+    base_hour,
+    *, num_issuers: int, max_probes: int, flag_cap: int,
+    bucket: bool = False, axis: str = AXIS,
+):
+    """Per-device body of the PRE-PARSED sharded step.
+
+    Lanes arrive ALREADY ROUTED: the host computed every lane's home
+    shard from its fingerprint (`core.packing.fingerprints_np` +
+    `shard_of_np` — the same hash `_shard_of` uses) and partitioned the
+    compact sidecar fields per shard before H2D. So this body is pure
+    shard-local work — fingerprint + insert + counts, no dispatch, no
+    ``all_to_all`` — and the only collective is the `psum` on the
+    per-issuer fresh-insert counts. The ~59 B/lane wire win of the
+    pre-parsed lane survives intact (row bytes never ship; the walker
+    path would have moved padded rows over the batch axis instead).
+
+    Outputs mirror `pipeline.preparsed_core`'s compact readback, per
+    shard: one int32 row [inserted, ovf_count, was-unknown bitmask,
+    compacted overflow lane ids] + the full overflow bitmask (fetched
+    only on a compacted-flag spill) + replicated psum'd counts.
+    """
+    c = serial_len.shape[0]  # per-shard lane slots
+    nb = -(-c // 32)
+    if bucket:
+        state = buckettable.BucketTable(table_rows, table_count)
+    else:
+        state = hashtable.TableState(table_rows, table_count)
+    fps = pipeline.fingerprints(issuer_idx, not_after_hour, serials,
+                                serial_len)
+    hour_off = not_after_hour - base_hour
+    meta = (
+        (issuer_idx.astype(jnp.uint32) << packing.META_HOUR_BITS)
+        | jnp.clip(hour_off, 0, packing.META_HOUR_SPAN - 1).astype(
+            jnp.uint32)
+    )
+    state, wu, ovf = pipeline.table_insert(
+        state, fps, meta, insertable, max_probes=max_probes
+    )
+    local_counts = jnp.zeros((num_issuers,), jnp.int32).at[issuer_idx].add(
+        wu.astype(jnp.int32), mode="drop"
+    )
+    counts = jax.lax.psum(local_counts, axis)
+    iota = jnp.arange(c, dtype=jnp.int32)
+    ovf_idx = jnp.sort(jnp.where(ovf, iota, c))[:flag_cap]
+    if flag_cap > c:
+        ovf_idx = jnp.pad(ovf_idx, (0, flag_cap - c), constant_values=c)
+    row = jnp.concatenate([
+        jnp.stack([wu.sum(dtype=jnp.int32), ovf.sum(dtype=jnp.int32)]),
+        jax.lax.bitcast_convert_type(
+            pipeline._pack_bits(wu, nb), jnp.int32),
+        ovf_idx,
+    ])
+    return (
+        state.rows, state.count,
+        row[None],                          # → int32[n_shards, 2+nb+cap]
+        pipeline._pack_bits(ovf, nb)[None],  # → uint32[n_shards, nb]
+        counts,                              # replicated
+    )
+
+
 class ShardedDedup:
     """Mesh-wide dedup state + the compiled sharded step.
 
@@ -467,6 +540,63 @@ class ShardedDedup:
         )
         return out
 
+    def _preparsed_fn(self, c: int, flag_cap: int):
+        """Compiled pre-parsed step for per-shard width ``c`` (cached;
+        the caller pads c to a power of two so shape churn is log-
+        bounded)."""
+        key = ("preparsed", c, flag_cap)
+        fn = self._step_cache.get(key)
+        if fn is not None:
+            return fn
+        local = functools.partial(
+            _local_preparsed_step,
+            num_issuers=self.num_issuers,
+            max_probes=self.max_probes,
+            flag_cap=flag_cap,
+            bucket=self.layout == "bucket",
+            axis=self.axis,
+        )
+        A = P(self.axis)
+        mapped = shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(A, A, A, A, A, A, A, P()),
+            out_specs=(A, A, A, A, P()),
+            check_vma=False,
+        )
+        fn = jax.jit(mapped, donate_argnums=(0, 1))
+        self._step_cache[key] = fn
+        return fn
+
+    def step_preparsed(
+        self,
+        serials: np.ndarray,      # uint8[n_shards*C, MAX_SERIAL]
+        serial_len: np.ndarray,   # int32[n_shards*C]
+        not_after_hour: np.ndarray,
+        issuer_idx: np.ndarray,
+        insertable: np.ndarray,   # bool[n_shards*C]
+        flag_cap: int,
+    ):
+        """Walker-free sharded step over HOST-ROUTED sidecar lanes:
+        slot ``s*C + j`` belongs to shard ``s`` (the caller routed each
+        lane to ``shard_of_np(fingerprints_np(...))`` and padded every
+        shard's range to C with insertable=False slots). Returns
+        ``(packed, overflow_bits, counts)`` device arrays — the
+        per-shard compact readback of `_local_preparsed_step`."""
+        ns = self.n_shards
+        c = int(serial_len.shape[0]) // ns
+        fn = self._preparsed_fn(c, flag_cap)
+        sh = NamedSharding(self.mesh, P(self.axis))
+        args = [
+            jax.device_put(jnp.asarray(x), sh)
+            for x in (serials, serial_len, not_after_hour,
+                      issuer_idx, insertable)
+        ]
+        self.rows, self.count, packed, ovf_bits, counts = fn(
+            self.rows, self.count, *args, jnp.int32(self.base_hour)
+        )
+        return packed, ovf_bits, counts
+
     def _bulk_insert_fn(self, width: int):
         cache_key = ("bulk", width)
         fn = self._step_cache.get(cache_key)
@@ -510,10 +640,7 @@ class ShardedDedup:
         n = self.n_shards
         if keys_np.size == 0:
             return 0
-        # uint32 wraparound arithmetic, matching _shard_of on device.
-        k = keys_np.astype(np.uint32)
-        h = k[:, 2] ^ (k[:, 3] * np.uint32(0x85EBCA6B))
-        dest = (h % np.uint32(n)).astype(np.int64)
+        dest = shard_of_np(keys_np, n).astype(np.int64)
         per_shard = [np.flatnonzero(dest == i) for i in range(n)]
         max_len = max(idx.size for idx in per_shard)
         overflowed = 0

@@ -96,6 +96,7 @@ def build_sink(config: CTConfig, database, backend=None):
                               backend=pem_backend,
                               device_queue_depth=config.device_queue_depth,
                               decode_workers=config.decode_workers,
+                              decode_threads=config.decode_threads,
                               overlap_workers=config.overlap_workers,
                               preparsed=config.preparsed_ingest or None,
                               ), model

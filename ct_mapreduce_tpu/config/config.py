@@ -31,6 +31,8 @@ class CTConfig:
     log_url_list: str = ""  # "logList"
     num_threads: int = 1
     decode_workers: int = 0  # 0 = auto (cpu count); raw-batch decode pool
+    decode_threads: int = 0  # 0 = auto; intra-chunk native decode threads
+    # (the persistent C++ worker pool; CTMR_DECODE_THREADS equivalent)
     overlap_workers: int = 0  # >0 = overlapped ingest (decode‖device‖drain)
     preparsed_ingest: bool = False  # host sidecar extraction + walker-free
     # device step (CTMR_PREPARSED=1 equivalent; needs the native decoder)
@@ -69,6 +71,7 @@ class CTConfig:
         "logList": ("log_url_list", str),
         "numThreads": ("num_threads", int),
         "decodeWorkers": ("decode_workers", int),
+        "decodeThreads": ("decode_threads", int),
         "overlapWorkers": ("overlap_workers", int),
         "preparsedIngest": ("preparsed_ingest", bool),
         "logExpiredEntries": ("log_expired_entries", bool),
@@ -221,6 +224,9 @@ class CTConfig:
             "logExpiredEntries = Add expired entries to the database",
             "numThreads = Use this many threads for normal operations",
             "decodeWorkers = native leaf-decode threads (0 = cpu count)",
+            "decodeThreads = intra-chunk native decode/sidecar threads "
+            "(0 = CTMR_DECODE_THREADS, then cpu count; workers x threads "
+            "should stay <= host cores)",
             "overlapWorkers = overlapped-ingest decode pool size (0 = serial dispatch)",
             "preparsedIngest = host sidecar extraction + walker-free device step",
             "savePeriod = Duration between state saves, e.g. 15m",

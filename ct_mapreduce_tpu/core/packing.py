@@ -130,3 +130,104 @@ def fingerprint_host(issuer_idx: int, exp_hour: int, serial: bytes) -> tuple[int
     return tuple(
         int.from_bytes(digest[16 + 4 * i : 20 + 4 * i], "big") for i in range(4)
     )
+
+
+# FIPS 180-4 SHA-256 constants for the vectorized host fingerprint
+# below (duplicated from ops/sha256.py rather than imported: core/
+# stays jax-free, and the constants are spec values, not code).
+_SHA_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5,
+        0x3956C25B, 0x59F111F1, 0x923F82A4, 0xAB1C5ED5,
+        0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174,
+        0xE49B69C1, 0xEFBE4786, 0x0FC19DC6, 0x240CA1CC,
+        0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7,
+        0xC6E00BF3, 0xD5A79147, 0x06CA6351, 0x14292967,
+        0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85,
+        0xA2BFE8A1, 0xA81A664B, 0xC24B8B70, 0xC76C51A3,
+        0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5,
+        0x391C0CB3, 0x4ED8AA4A, 0x5B9CCA4F, 0x682E6FF3,
+        0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_SHA_H0 = np.array(
+    [
+        0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+        0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+    ],
+    dtype=np.uint32,
+)
+
+
+def fingerprints_np(
+    issuer_idx: np.ndarray,
+    exp_hour: np.ndarray,
+    serials: np.ndarray,
+    serial_len: np.ndarray,
+) -> np.ndarray:
+    """Vectorized host mirror of the device fingerprint pipeline
+    (:func:`ct_mapreduce_tpu.ops.pipeline.fingerprints` →
+    ``sha256_fingerprint64``): ``uint32[n, 4]`` dedup-key words from
+    the sidecar's compact per-lane fields, no device round trip.
+
+    The sharded pre-parsed lane uses this to compute every lane's home
+    shard ON THE HOST (routing is a pure function of the fingerprint),
+    so sidecars partition per shard before H2D and no ``all_to_all``
+    runs on device. Bytes of ``serials`` past ``serial_len`` must
+    already be zero (the sidecar serial window guarantees it), exactly
+    as the device path assumes.
+    """
+    n = int(len(issuer_idx))
+    if n == 0:
+        return np.zeros((0, 4), np.uint32)
+    eh = np.asarray(exp_hour).astype(np.uint32)
+    ii = np.asarray(issuer_idx).astype(np.uint32)
+    slen = np.asarray(serial_len).astype(np.int64)
+    msg = np.zeros((n, 64), np.uint8)
+    for j, v in enumerate((eh >> 24, eh >> 16, eh >> 8, eh,
+                           ii >> 24, ii >> 16, ii >> 8, ii)):
+        msg[:, j] = (v & 0xFF).astype(np.uint8)
+    msg[:, 8] = (slen & 0xFF).astype(np.uint8)
+    msg[:, 9:9 + MAX_SERIAL_BYTES] = np.asarray(serials, np.uint8)
+    msg_len = 9 + slen  # ≤ 55: single block after FIPS padding
+    msg = np.where(np.arange(64)[None, :] == msg_len[:, None],
+                   np.uint8(0x80), msg)
+    bits = (msg_len * 8).astype(np.uint32)
+    msg[:, 62] = ((bits >> 8) & 0xFF).astype(np.uint8)
+    msg[:, 63] = (bits & 0xFF).astype(np.uint8)
+    w4 = msg.reshape(n, 16, 4).astype(np.uint32)
+    block = ((w4[:, :, 0] << 24) | (w4[:, :, 1] << 16)
+             | (w4[:, :, 2] << 8) | w4[:, :, 3])
+
+    def rotr(x: np.ndarray, r: int) -> np.ndarray:
+        return ((x >> np.uint32(r)) | (x << np.uint32(32 - r))).astype(
+            np.uint32)
+
+    # Message schedule + 64 compression rounds, all wrapping uint32.
+    w = np.zeros((64, n), np.uint32)
+    w[:16] = block.T
+    for t in range(16, 64):
+        s0 = rotr(w[t - 15], 7) ^ rotr(w[t - 15], 18) ^ (
+            w[t - 15] >> np.uint32(3))
+        s1 = rotr(w[t - 2], 17) ^ rotr(w[t - 2], 19) ^ (
+            w[t - 2] >> np.uint32(10))
+        w[t] = w[t - 16] + s0 + w[t - 7] + s1
+    a, b, c, d, e, f, g, h = (
+        np.full((n,), _SHA_H0[i], np.uint32) for i in range(8))
+    for t in range(64):
+        s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + _SHA_K[t] + w[t]
+        s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    digest = np.stack([a, b, c, d, e, f, g, h], axis=1) + _SHA_H0[None, :]
+    return digest[:, 4:]  # low 128 bits, like sha256_fingerprint64

@@ -60,17 +60,35 @@ _SENTINEL = object()
 class OverlapIngestPipeline:
     """Three-stage overlap scheduler over one :class:`AggregatorSink`.
 
-    ``decode_workers`` sizes the decode pool (each worker runs the
-    whole native chunk decode, which itself fans out across cores with
-    the GIL released); ``queue_depth`` bounds device batches that are
-    submitted-but-undrained — the double-buffer depth. Memory bound:
-    at most ``decode_workers + 1`` prepared chunks plus ``queue_depth``
-    in-flight device batches are alive at once.
+    ``decode_workers`` sizes the decode pool (each worker runs one
+    whole-chunk native decode with the GIL released); ``queue_depth``
+    bounds device batches that are submitted-but-undrained — the
+    double-buffer depth. Memory bound: at most ``decode_workers + 1``
+    prepared chunks plus ``queue_depth`` in-flight device batches are
+    alive at once.
+
+    **Sizing vs intra-chunk decode threads.** Host decode parallelism
+    now has two axes: this pool runs W whole chunks concurrently, and
+    inside each chunk the native worker pool splits lane ranges over T
+    threads (``decodeThreads`` directive / ``CTMR_DECODE_THREADS``,
+    ``leafpack.resolve_threads``). Both axes burn the same cores, so
+    size them as **W × T ≤ host cores**: oversubscribing buys nothing
+    (the native pool runs one parallel region at a time; an extra
+    region decodes serially) and inflates the prepared-chunk memory
+    window. ``decode_workers=0`` (the default) auto-sizes W from
+    ``os.cpu_count() / T`` clamped to [1, 8] — with T at its own
+    default (all cores) that is W=1, i.e. intra-chunk threads do the
+    scaling and this pool only keeps one chunk decoding ahead of the
+    device; pinning T smaller (e.g. ``decodeThreads=4`` on a 32-core
+    host) shifts the parallelism back to whole-chunk pipelining.
+    The ``overlapWorkers`` directive overrides W explicitly.
     """
 
-    def __init__(self, sink, decode_workers: int = 2, queue_depth: int = 2,
+    def __init__(self, sink, decode_workers: int = 0, queue_depth: int = 2,
                  max_prepared: Optional[int] = None):
         self._sink = sink
+        if int(decode_workers) <= 0:
+            decode_workers = self._auto_workers(sink)
         self.decode_workers = max(1, int(decode_workers))
         self.queue_depth = max(1, int(queue_depth))
         self._pool = ThreadPoolExecutor(
@@ -116,6 +134,20 @@ class OverlapIngestPipeline:
             target=self._drain_loop, name="ovl-drain", daemon=True)
         self._submit_t.start()
         self._drain_t.start()
+
+    @staticmethod
+    def _auto_workers(sink=None) -> int:
+        """Default decode-pool width: the W of the W × T ≤ cores rule
+        (docstring above), honoring the sink's configured intra-chunk
+        thread count when it has one."""
+        import os
+
+        from ct_mapreduce_tpu.native import leafpack
+
+        cores = os.cpu_count() or 1
+        t = leafpack.resolve_threads(
+            1 << 20, getattr(sink, "decode_threads", None))
+        return max(1, min(8, cores // max(1, t)))
 
     # -- producer side ---------------------------------------------------
     def submit_chunk(self, pairs) -> None:

@@ -130,7 +130,8 @@ class AggregatorSink:
 
     def __init__(self, aggregator, flush_size: int = 4096, backend=None,
                  device_queue_depth: int = 2, decode_workers: int = 0,
-                 overlap_workers: int = 0, preparsed: Optional[bool] = None):
+                 overlap_workers: int = 0, preparsed: Optional[bool] = None,
+                 decode_threads: int = 0):
         self.aggregator = aggregator
         self.flush_size = flush_size
         # Optional durable backend (certPath): first-seen certs get the
@@ -153,6 +154,15 @@ class AggregatorSink:
         self.device_queue_depth = max(0, int(device_queue_depth))
         # 0 = leafpack auto-sizing (CTMR_DECODE_WORKERS / cpu count).
         self.decode_workers = int(decode_workers) or None
+        # Intra-chunk native decode threads (`decodeThreads` directive /
+        # CTMR_DECODE_THREADS): the persistent C++ worker pool splits
+        # each chunk's decode, row pack, and sidecar extraction over
+        # lane ranges. 0 = leafpack auto (env, then cpu count). This is
+        # the knob that makes ONE chunk's host feed scale with cores;
+        # `overlapWorkers` pipelines ACROSS chunks on top of it
+        # (workers × threads should stay ≤ host cores, see
+        # ingest/overlap.py).
+        self.decode_threads = int(decode_threads) or None
         self._inflight: deque = deque()  # (PendingIngest, der_of)
         # Without a PEM backend the per-entry serial bytes are only
         # needed for the cross-encoding guard; let the aggregator skip
@@ -267,16 +277,30 @@ class AggregatorSink:
             max_li_raw = max((len(s) for s in lis), default=0) * 3 // 4
             if max_li_raw + 64 <= narrow:
                 pad = narrow
+        t_dec = time.monotonic()
         with metrics.measure("ct-fetch", "decodeBatch"):
             dec = leafpack.decode_raw_batch(
-                lis, eds, pad, workers=self.decode_workers
+                lis, eds, pad, workers=self.decode_workers,
+                threads=self.decode_threads,
             )
             if (pad < self.PAD_LEN
                     and bool((dec.status == leafpack.TOO_LONG).any())):
                 pad = self.PAD_LEN
                 dec = leafpack.decode_raw_batch(
-                    lis, eds, pad, workers=self.decode_workers
+                    lis, eds, pad, workers=self.decode_workers,
+                    threads=self.decode_threads,
                 )
+        # Host-feed observability: the resolved intra-chunk thread
+        # count (gauge) and this chunk's decode cost (ns/entry sample)
+        # — the two numbers that say whether the feed is scaling.
+        if len(lis):
+            metrics.set_gauge(
+                "ingest", "decode_threads",
+                value=float(leafpack.resolve_threads(
+                    len(lis), self.decode_threads or self.decode_workers)))
+            metrics.add_sample(
+                "ingest", "decode_ns_per_entry",
+                value=(time.monotonic() - t_dec) / len(lis) * 1e9)
         # When the batch decoded wide but every cert fits half the
         # pad, ship the narrow view — H2D bytes halve (the dominant
         # cost on tunneled links), at the price of one extra compiled
@@ -358,7 +382,9 @@ class AggregatorSink:
         sidecar = None
         walker_fallback: list[tuple[bytes, bytes]] = []
         if self.preparsed:
-            sidecar = leafpack.extract_sidecars(data, dec.length)
+            sidecar = leafpack.extract_sidecars(
+                data, dec.length,
+                threads=self.decode_threads or self.decode_workers)
             if sidecar is not None:
                 pre_ok = sidecar.ok.astype(bool)
                 for i in np.nonzero(valid & ~pre_ok)[0]:

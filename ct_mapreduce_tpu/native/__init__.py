@@ -40,7 +40,7 @@ def _build(so: str) -> bool:
         try:
             res = subprocess.run(
                 [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
-                 "-o", tmp, _SRC],
+                 "-pthread", "-o", tmp, _SRC],
                 capture_output=True, timeout=240,
             )
         except (FileNotFoundError, subprocess.TimeoutExpired):
@@ -108,6 +108,53 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             u8p, i32p, u8p,
         ]
+        # Multi-threaded entry points (worker-pool lane-range split).
+        # A prebuilt .so from before the pool existed may lack them —
+        # the mtime check rebuilds from source when possible, but a
+        # compiler-less host with a stale cached library must still
+        # load: callers check `has_mt` and stay on the serial paths.
+        try:
+            lib.ctmr_decode_entries_mt.restype = ctypes.c_int64
+            lib.ctmr_decode_entries_mt.argtypes = [
+                ctypes.c_int64,
+                ctypes.c_char_p, i64p,
+                ctypes.c_char_p, i64p,
+                ctypes.c_int64,
+                u8p, i32p,
+                i64p, i32p,
+                u8p, ctypes.c_int64,
+                i64p, i32p,
+                i32p,
+                u8p, ctypes.c_int64,
+                ctypes.c_int64, i64p,
+            ]
+            lib.ctmr_extract_sidecars_mt.restype = None
+            lib.ctmr_extract_sidecars_mt.argtypes = [
+                ctypes.c_int64,
+                u8p, ctypes.c_int64, i32p,
+                u8p,
+                i32p, i32p,
+                i32p,
+                u8p, u8p,
+                i32p, i32p,
+                i32p, i32p,
+                i32p, i32p,
+                i32p, i32p,
+                ctypes.c_int64,
+            ]
+            lib.ctmr_pack_ders_mt.restype = ctypes.c_int64
+            lib.ctmr_pack_ders_mt.argtypes = [
+                ctypes.c_int64,
+                u8p, i64p,
+                ctypes.c_int64,
+                u8p, i32p, u8p,
+                ctypes.c_int64,
+            ]
+            lib.ctmr_pool_threads.restype = ctypes.c_int64
+            lib.ctmr_pool_threads.argtypes = []
+            lib.has_mt = True
+        except AttributeError:
+            lib.has_mt = False
         _LIB = lib
         return _LIB
 

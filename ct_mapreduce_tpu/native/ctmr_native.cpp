@@ -13,8 +13,111 @@
 // ABI: plain C, consumed via ctypes (no pybind11 in the image). All
 // buffers are caller-allocated numpy arrays.
 
+#include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace pool {
+
+// Persistent, lazily-grown worker pool shared by the *_mt entry
+// points. One global instance per process; threads are created the
+// first time a caller asks for them and then parked on a condition
+// variable between batches (thread create/join per 64K-entry chunk
+// would cost more than the decode it parallelizes). The instance is
+// deliberately leaked: parked workers may still exist at process
+// exit and C++ static destruction order makes tearing them down
+// unsafe — the OS reclaims them.
+class WorkerPool {
+ public:
+  static WorkerPool& get() {
+    static WorkerPool* p = new WorkerPool();
+    return *p;
+  }
+
+  int active_workers() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return (int)workers_.size() + 1;  // + the calling thread
+  }
+
+  // Run fn(chunk) for chunk in [0, n_chunks) with up to `threads`
+  // concurrent executors (the calling thread participates). Blocks
+  // until every chunk finished. Chunk claiming is an atomic counter,
+  // so which THREAD runs a chunk is nondeterministic — callers must
+  // make each chunk's writes a pure function of its chunk id (disjoint
+  // output ranges, no shared accumulators) to keep results
+  // bit-identical to a serial pass.
+  void run(int threads, int n_chunks, const std::function<void(int)>& fn) {
+    if (threads <= 1 || n_chunks <= 1) {
+      for (int c = 0; c < n_chunks; ++c) fn(c);
+      return;
+    }
+    // One parallel region at a time: the Python side may issue
+    // concurrent decode calls (overlap pipeline workers); the second
+    // caller just runs serially rather than queueing behind the pool.
+    std::unique_lock<std::mutex> region(run_mu_, std::try_to_lock);
+    if (!region.owns_lock()) {
+      for (int c = 0; c < n_chunks; ++c) fn(c);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      while ((int)workers_.size() < threads - 1) {
+        workers_.emplace_back([this] { worker_loop(); });
+      }
+      fn_ = fn;
+      remaining_ = n_chunks;
+      // n_chunks_ and fn_ are published by the release store on
+      // next_: a worker only dereferences them after its acquire
+      // fetch_add observes the reset counter.
+      n_chunks_.store(n_chunks, std::memory_order_relaxed);
+      next_.store(0, std::memory_order_release);
+      ++epoch_;
+    }
+    cv_.notify_all();
+    work();  // caller participates
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [&] { return remaining_ == 0; });
+  }
+
+ private:
+  void work() {
+    for (;;) {
+      int c = next_.fetch_add(1, std::memory_order_acquire);
+      if (c >= n_chunks_.load(std::memory_order_relaxed)) return;
+      fn_(c);
+      std::lock_guard<std::mutex> lk(mu_);
+      if (--remaining_ == 0) done_cv_.notify_all();
+    }
+  }
+  void worker_loop() {
+    uint64_t seen = 0;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return epoch_ != seen; });
+        seen = epoch_;
+      }
+      work();
+    }
+  }
+
+  std::mutex run_mu_;  // serializes parallel regions
+  std::mutex mu_;      // guards pool state below
+  std::condition_variable cv_, done_cv_;
+  std::vector<std::thread> workers_;
+  std::function<void(int)> fn_;
+  std::atomic<int> next_{0};
+  std::atomic<int> n_chunks_{0};
+  int remaining_ = 0;
+  uint64_t epoch_ = 0;
+};
+
+}  // namespace pool
 
 namespace {
 
@@ -683,6 +786,119 @@ int64_t ctmr_pack_ders(
     ++packed;
   }
   return packed;
+}
+
+// ---------------------------------------------------------------------
+// Multi-threaded entry points: each splits its batch into `threads`
+// contiguous lane ranges (chunk t = lanes [n*t/T, n*(t+1)/T)) and runs
+// the serial function above on each range through the persistent
+// worker pool. Every per-lane output is written by exactly one chunk
+// into its own row range, so data/length/ts/entry_ty/status (and every
+// sidecar array) are BIT-IDENTICAL to the serial pass regardless of
+// thread scheduling. The only shared-accumulator outputs — the issuer
+// dedup buffer and its spans — are made deterministic by partitioning:
+// chunk t appends into its own issuer_buf slice [t*cap/T, (t+1)*cap/T)
+// with a chunk-local dedup table, and the Python caller merges the
+// per-chunk groups by DER bytes in chunk order (= lane order), which
+// reproduces the serial first-appearance group order exactly.
+
+int64_t ctmr_decode_entries_mt(
+    int64_t n,
+    const char* li_buf, const int64_t* li_off,
+    const char* ed_buf, const int64_t* ed_off,
+    int64_t pad_len,
+    uint8_t* data, int32_t* length,
+    int64_t* ts_ms, int32_t* entry_ty,
+    uint8_t* issuer_buf, int64_t issuer_cap,
+    int64_t* issuer_off, int32_t* issuer_len,
+    int32_t* status,
+    uint8_t* scratch, int64_t scratch_each,  // scratch holds T spans
+    int64_t threads, int64_t* chunk_used /* [threads] out */) {
+  if (n <= 0) return 0;
+  int T = (int)threads;
+  if (T < 1) T = 1;
+  if ((int64_t)T > n) T = (int)n;
+  int64_t iss_each = issuer_cap / T;
+  pool::WorkerPool::get().run(T, T, [&](int t) {
+    int64_t lo = n * t / T, hi = n * (t + 1) / T;
+    int64_t base = (int64_t)t * iss_each;
+    // li_off/ed_off entries are absolute offsets into the shared
+    // buffers, so passing the shifted pointer re-bases lane indexing
+    // while byte addressing stays global.
+    int64_t used = ctmr_decode_entries(
+        hi - lo, li_buf, li_off + lo, ed_buf, ed_off + lo, pad_len,
+        data + lo * pad_len, length + lo, ts_ms + lo, entry_ty + lo,
+        issuer_buf + base, iss_each, issuer_off + lo, issuer_len + lo,
+        status + lo, scratch + (int64_t)t * scratch_each, scratch_each);
+    if (used >= 0) {
+      // Chunk-local spans → global offsets into the shared buffer.
+      for (int64_t i = lo; i < hi; ++i) {
+        if (issuer_len[i] > 0) issuer_off[i] += base;
+      }
+    }
+    chunk_used[t] = used;
+  });
+  for (int t = T; t < (int)threads; ++t) chunk_used[t] = 0;
+  int64_t total = 0;
+  for (int t = 0; t < T; ++t) {
+    if (chunk_used[t] < 0) return -1;  // a chunk's issuer slice overflowed
+    total += chunk_used[t];
+  }
+  return total;
+}
+
+void ctmr_extract_sidecars_mt(
+    int64_t n,
+    const uint8_t* data, int64_t pad_len, const int32_t* length,
+    uint8_t* ok,
+    int32_t* serial_off, int32_t* serial_len,
+    int32_t* not_after_hour,
+    uint8_t* is_ca, uint8_t* has_crldp,
+    int32_t* cn_off, int32_t* cn_len,
+    int32_t* issuer_off, int32_t* issuer_len,
+    int32_t* spki_off, int32_t* spki_len,
+    int32_t* crldp_off, int32_t* crldp_len,
+    int64_t threads) {
+  if (n <= 0) return;
+  int T = (int)threads;
+  if (T < 1) T = 1;
+  if ((int64_t)T > n) T = (int)n;
+  pool::WorkerPool::get().run(T, T, [&](int t) {
+    int64_t lo = n * t / T, hi = n * (t + 1) / T;
+    ctmr_extract_sidecars(
+        hi - lo, data + lo * pad_len, pad_len, length + lo,
+        ok + lo, serial_off + lo, serial_len + lo, not_after_hour + lo,
+        is_ca + lo, has_crldp + lo, cn_off + lo, cn_len + lo,
+        issuer_off + lo, issuer_len + lo, spki_off + lo, spki_len + lo,
+        crldp_off + lo, crldp_len + lo);
+  });
+}
+
+int64_t ctmr_pack_ders_mt(
+    int64_t n,
+    const uint8_t* blob, const int64_t* off,
+    int64_t pad_len,
+    uint8_t* data, int32_t* length, uint8_t* okflags,
+    int64_t threads) {
+  if (n <= 0) return 0;
+  int T = (int)threads;
+  if (T < 1) T = 1;
+  if ((int64_t)T > n) T = (int)n;
+  std::vector<int64_t> packed((size_t)T, 0);
+  pool::WorkerPool::get().run(T, T, [&](int t) {
+    int64_t lo = n * t / T, hi = n * (t + 1) / T;
+    packed[(size_t)t] = ctmr_pack_ders(
+        hi - lo, blob, off + lo, pad_len,
+        data + lo * pad_len, length + lo, okflags + lo);
+  });
+  int64_t total = 0;
+  for (int t = 0; t < T; ++t) total += packed[(size_t)t];
+  return total;
+}
+
+// Pool introspection (the ingest.decode_threads gauge reads it).
+int64_t ctmr_pool_threads() {
+  return pool::WorkerPool::get().active_workers();
 }
 
 }  // extern "C"

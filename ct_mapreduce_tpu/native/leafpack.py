@@ -94,13 +94,40 @@ class Sidecar:
     crldp_len: np.ndarray  # int32[n]
 
 
-def extract_sidecars(data: np.ndarray,
-                     length: np.ndarray) -> Optional[Sidecar]:
+def resolve_threads(n: int, threads: Optional[int] = None) -> int:
+    """Effective intra-chunk native thread count for an ``n``-lane call.
+
+    An explicit ``threads`` > 0 is honored as given, clamped only to
+    the lane count (tests exercise the threaded stitch on tiny
+    batches). Otherwise: ``CTMR_DECODE_THREADS`` env, then the legacy
+    ``CTMR_DECODE_WORKERS``, then ``os.cpu_count()`` — auto-sized so
+    every chunk keeps >= 2048 lanes (below that the split overhead
+    exceeds the decode it parallelizes).
+    """
+    import os
+
+    if threads is not None and int(threads) > 0:
+        return max(1, min(int(threads), max(int(n), 1)))
+    t = int(os.environ.get("CTMR_DECODE_THREADS", "0") or 0)
+    if t <= 0:
+        t = int(os.environ.get("CTMR_DECODE_WORKERS", "0") or 0)
+    if t <= 0:
+        t = os.cpu_count() or 1
+    t = max(1, min(t, n // 2048)) if n >= 4096 else 1
+    return max(1, min(t, 256))
+
+
+def extract_sidecars(data: np.ndarray, length: np.ndarray,
+                     threads: Optional[int] = None) -> Optional[Sidecar]:
     """Pre-parsed sidecars for packed rows ``uint8[n, pad]`` +
     ``int32[n]`` lengths, or None when the native library is
     unavailable (callers then stay on the device-walker lane —
     there is deliberately no Python fallback: the contract is
-    walker-exactness, and the walker itself is always available)."""
+    walker-exactness, and the walker itself is always available).
+
+    ``threads`` > 1 splits the lane range across the native worker
+    pool; every lane's outputs are written by exactly one chunk, so
+    results are byte-identical to the serial pass."""
     import os
 
     if os.environ.get("CTMR_NATIVE", "1") == "0":
@@ -119,7 +146,11 @@ def extract_sidecars(data: np.ndarray,
     (serial_off, serial_len, not_after_hour, cn_off, cn_len,
      issuer_off, issuer_len, spki_off, spki_len,
      crldp_off, crldp_len) = out_i32
-    lib.ctmr_extract_sidecars(
+    t = resolve_threads(n, threads)
+    fn, extra = lib.ctmr_extract_sidecars, ()
+    if t > 1 and getattr(lib, "has_mt", False):
+        fn, extra = lib.ctmr_extract_sidecars_mt, (t,)
+    fn(
         n, data.ctypes.data_as(u8p), data.shape[1],
         length.ctypes.data_as(i32p),
         ok.ctypes.data_as(u8p),
@@ -130,6 +161,7 @@ def extract_sidecars(data: np.ndarray,
         issuer_off.ctypes.data_as(i32p), issuer_len.ctypes.data_as(i32p),
         spki_off.ctypes.data_as(i32p), spki_len.ctypes.data_as(i32p),
         crldp_off.ctypes.data_as(i32p), crldp_len.ctypes.data_as(i32p),
+        *extra,
     )
     return Sidecar(
         ok=ok, serial_off=serial_off, serial_len=serial_len,
@@ -168,15 +200,26 @@ def decode_raw_batch(
     extra_datas: Sequence[str],
     pad_len: int,
     workers: Optional[int] = None,
+    threads: Optional[int] = None,
 ) -> DecodedBatch:
     """Decode one get-entries response into packed device arrays.
 
-    ``workers`` > 1 splits the batch across a thread pool — the ctypes
-    call releases the GIL, so on multi-core TPU hosts decode scales
-    with cores (it is the e2e ingest bottleneck at ~200k entries/s per
-    core; a 10M entries/s chip needs tens of decode cores feeding it).
-    Default: ``CTMR_DECODE_WORKERS`` env, else ``os.cpu_count()``,
-    bounded so each chunk keeps >= 2048 entries.
+    ``threads`` > 1 splits the batch across the native library's
+    persistent worker pool — one ctypes call, lane ranges decoded in
+    parallel inside C++ with the GIL released — so on multi-core TPU
+    hosts decode scales with cores (it is the e2e ingest bottleneck at
+    ~200k entries/s per core; a 10M entries/s chip needs tens of
+    decode cores feeding it). ``workers`` is the legacy alias for the
+    same knob (used when ``threads`` is unset). Default: the
+    :func:`resolve_threads` policy (``CTMR_DECODE_THREADS`` env →
+    ``CTMR_DECODE_WORKERS`` → ``os.cpu_count()``, bounded so each
+    chunk keeps >= 2048 entries).
+
+    Determinism: per-lane outputs are written by exactly one chunk
+    into disjoint ranges, and per-chunk issuer groups merge by DER
+    bytes in chunk (= lane) order, so the returned
+    :class:`DecodedBatch` is byte-identical across thread counts
+    (pinned by tests/test_decode_threads.py).
     """
     import os
 
@@ -189,15 +232,9 @@ def decode_raw_batch(
     if lib is None:
         return _decode_python(leaf_inputs, extra_datas, pad_len)
 
-    if workers is None:
-        workers = int(os.environ.get("CTMR_DECODE_WORKERS", "0")) or (
-            os.cpu_count() or 1
-        )
-        # Auto-sizing keeps >= 2048 entries per chunk; an explicit
-        # ``workers`` argument is honored as given (tests exercise the
-        # threaded path on small batches).
-        workers = max(1, min(workers, n // 2048)) if n >= 4096 else 1
-    workers = max(1, min(workers, n)) if n else 1
+    t = resolve_threads(n, threads if threads else workers)
+    if not getattr(lib, "has_mt", False):
+        t = 1  # stale prebuilt library without the pool entry points
 
     data = np.zeros((n, pad_len), np.uint8)
     length = np.zeros((n,), np.int32)
@@ -206,40 +243,29 @@ def decode_raw_batch(
     status = np.zeros((n,), np.int32)
     out = (data, length, ts, ety, status)
 
-    if workers > 1:
-        # Chunks write into disjoint row ranges of the preallocated
-        # outputs (contiguous views — no post-hoc concatenate, no 2x
-        # peak memory); the ctypes call drops the GIL, so chunks run
-        # in parallel on multi-core hosts.
-        from concurrent.futures import ThreadPoolExecutor
-
-        bounds = [(k * n) // workers for k in range(workers + 1)]
-        ranges = [(bounds[k], bounds[k + 1]) for k in range(workers)
-                  if bounds[k + 1] > bounds[k]]
-
-        def run(lo: int, hi: int):
-            return _decode_native_into(
-                lib, leaf_inputs[lo:hi], extra_datas[lo:hi], pad_len,
-                tuple(a[lo:hi] for a in out),
-            )
-
-        with ThreadPoolExecutor(max_workers=len(ranges)) as pool:
-            spans = list(pool.map(lambda r: run(*r), ranges))
-        if any(s is None for s in spans):  # native scratch overflow
-            return _decode_python(leaf_inputs, extra_datas, pad_len)
-        # Merge per-chunk issuer groups by DER bytes (a handful per
-        # chunk — per-group work, never per-entry).
-        group = np.full((n,), -1, np.int32)
-        group_issuers: list = []
-        gid_of: dict = {}
-        for (lo, hi), span in zip(ranges, spans):
-            c_group, c_issuers = _issuer_groups(hi - lo, *span)
-            remap = np.full((len(c_issuers) + 1,), -1, np.int32)
-            for g, der in enumerate(c_issuers):
-                remap[g] = _assign_gid(gid_of, group_issuers, der)
-            group[lo:hi] = remap[c_group]
-        return DecodedBatch(data, length, ts, ety, None, status,
-                            issuer_group=group, group_issuers=group_issuers)
+    if t > 1:
+        spans = _decode_native_mt(
+            lib, leaf_inputs, extra_datas, pad_len, out, t)
+        if spans is not None:
+            # Merge per-chunk issuer groups by DER bytes in chunk
+            # order (a handful per chunk — per-group work, never
+            # per-entry). Chunks are contiguous lane ranges in lane
+            # order, so the merged group order equals the serial
+            # pass's first-appearance order.
+            group = np.full((n,), -1, np.int32)
+            group_issuers: list = []
+            gid_of: dict = {}
+            for (lo, hi), span in spans:
+                c_group, c_issuers = _issuer_groups(hi - lo, *span)
+                remap = np.full((len(c_issuers) + 1,), -1, np.int32)
+                for g, der in enumerate(c_issuers):
+                    remap[g] = _assign_gid(gid_of, group_issuers, der)
+                group[lo:hi] = remap[c_group]
+            return DecodedBatch(data, length, ts, ety, None, status,
+                                issuer_group=group,
+                                group_issuers=group_issuers)
+        # A chunk's issuer slice overflowed (pathologically skewed
+        # extra_data) — retry serial with the undivided buffer.
 
     span = _decode_native_into(lib, leaf_inputs, extra_datas, pad_len, out)
     if span is None:  # issuer scratch overflow — impossible by sizing
@@ -322,6 +348,112 @@ def _decode_native_into(
     if used < 0:
         return None
     return issuer_off, issuer_len, issuer_buf[:used]
+
+
+def _decode_native_mt(
+    lib,
+    leaf_inputs: Sequence[str],
+    extra_datas: Sequence[str],
+    pad_len: int,
+    out: tuple,
+    threads: int,
+) -> Optional[list]:
+    """One ``ctmr_decode_entries_mt`` call decoding ``threads``
+    contiguous lane ranges in parallel on the native worker pool.
+    Returns ``[((lo, hi), (issuer_off, issuer_len, issuer_buf))]`` per
+    chunk (spans carry GLOBAL offsets into the shared buffer), or None
+    when a chunk's issuer slice overflowed (caller retries serial)."""
+    n = len(leaf_inputs)
+    data, length, ts, ety, status = out
+    li_buf, li_off = _concat_b64(leaf_inputs)
+    ed_buf, ed_off = _concat_b64(extra_datas)
+    issuer_off = np.zeros((n,), np.int64)
+    issuer_len = np.zeros((n,), np.int32)
+    # Chunk bounds mirror the C split exactly: lane [n*t//T, n*(t+1)//T).
+    bounds = [(n * t) // threads for t in range(threads + 1)]
+    # Each chunk's issuer slice must hold that chunk's chain bytes;
+    # its base64 extra_data length is a safe upper bound on them.
+    iss_each = max(
+        4096,
+        max(int(ed_off[bounds[t + 1]] - ed_off[bounds[t]])
+            for t in range(threads)),
+    )
+    issuer_buf = np.zeros((threads * iss_each,), np.uint8)
+    max_li = int(np.max(np.diff(li_off))) if n else 0
+    max_ed = int(np.max(np.diff(ed_off))) if n else 0
+    scratch_each = max(max_li + max_ed + 64, 4096)
+    scratch = np.zeros((threads * scratch_each,), np.uint8)
+    chunk_used = np.zeros((threads,), np.int64)
+
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+
+    rc = lib.ctmr_decode_entries_mt(
+        n,
+        li_buf, li_off.ctypes.data_as(i64p),
+        ed_buf, ed_off.ctypes.data_as(i64p),
+        pad_len,
+        data.ctypes.data_as(u8p), length.ctypes.data_as(i32p),
+        ts.ctypes.data_as(i64p), ety.ctypes.data_as(i32p),
+        issuer_buf.ctypes.data_as(u8p), issuer_buf.shape[0],
+        issuer_off.ctypes.data_as(i64p), issuer_len.ctypes.data_as(i32p),
+        status.ctypes.data_as(i32p),
+        scratch.ctypes.data_as(u8p), scratch_each,
+        threads, chunk_used.ctypes.data_as(i64p),
+    )
+    if rc < 0:
+        return None
+    return [
+        ((bounds[t], bounds[t + 1]),
+         (issuer_off[bounds[t]:bounds[t + 1]],
+          issuer_len[bounds[t]:bounds[t + 1]],
+          issuer_buf))
+        for t in range(threads)
+        if bounds[t + 1] > bounds[t]
+    ]
+
+
+def pack_ders(ders: Sequence[bytes], pad_len: int,
+              threads: Optional[int] = None):
+    """Pack pre-decoded DER blobs into the ``[n, pad_len]`` device
+    layout via the native packer (parallel over lane ranges when
+    ``threads`` > 1); returns ``(data, length, ok, packed_count)`` or
+    None when the native library is unavailable."""
+    import os
+
+    if os.environ.get("CTMR_NATIVE", "1") == "0":
+        return None
+    lib = load_native()
+    if lib is None:
+        return None
+    n = len(ders)
+    blob = np.frombuffer(b"".join(ders) or b"\x00", np.uint8)
+    off = np.zeros((n + 1,), np.int64)
+    if n:
+        off[1:] = np.cumsum([len(d) for d in ders])
+    data = np.zeros((n, pad_len), np.uint8)
+    length = np.zeros((n,), np.int32)
+    ok = np.zeros((n,), np.uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    t = resolve_threads(n, threads)
+    if t > 1 and getattr(lib, "has_mt", False):
+        packed = lib.ctmr_pack_ders_mt(
+            n, blob.ctypes.data_as(u8p), off.ctypes.data_as(i64p),
+            pad_len,
+            data.ctypes.data_as(u8p), length.ctypes.data_as(i32p),
+            ok.ctypes.data_as(u8p), t,
+        )
+    else:
+        packed = lib.ctmr_pack_ders(
+            n, blob.ctypes.data_as(u8p), off.ctypes.data_as(i64p),
+            pad_len,
+            data.ctypes.data_as(u8p), length.ctypes.data_as(i32p),
+            ok.ctypes.data_as(u8p),
+        )
+    return data, length, ok, int(packed)
 
 
 def _decode_python(

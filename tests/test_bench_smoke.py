@@ -45,3 +45,10 @@ def test_bench_smoke_overlap_gate(monkeypatch):
         assert out["smoke_preparsed_flag_bytes"] > 0
         # Far below one int32 status row per chunk (the old readback).
         assert out["smoke_preparsed_flag_bytes"] < 4 * out["smoke_entries"]
+        # The sharded-preparsed leg ran (host-routed mesh path) with
+        # the same O(flagged) compact-readback budget, and the
+        # intra-chunk decode-thread parity leg passed.
+        assert out["smoke_sharded_preparsed_flag_bytes"] > 0
+        assert (out["smoke_sharded_preparsed_flag_bytes"]
+                < 4 * out["smoke_entries"])
+        assert out["smoke_decode_threads_parity"] == 1

@@ -625,9 +625,9 @@ def test_raw_batch_narrow_decode_and_redecode():
     pads_seen = []
     orig = leafpack.decode_raw_batch
 
-    def spy(lis, eds, pad_len, workers=None):
+    def spy(lis, eds, pad_len, workers=None, threads=None):
         pads_seen.append(pad_len)
-        return orig(lis, eds, pad_len, workers=workers)
+        return orig(lis, eds, pad_len, workers=workers, threads=threads)
 
 
     # (a) all-small batch: ONE decode at the narrow width.
@@ -716,9 +716,9 @@ def test_oversized_issuer_gets_own_status_no_redecode():
     pads_seen = []
     orig = leafpack.decode_raw_batch
 
-    def spy(l, e, pad_len, workers=None):
+    def spy(l, e, pad_len, workers=None, threads=None):
         pads_seen.append(pad_len)
-        return orig(l, e, pad_len, workers=workers)
+        return orig(l, e, pad_len, workers=workers, threads=threads)
 
     agg = TpuAggregator(capacity=1 << 12, batch_size=64,
                         now=datetime.datetime(2025, 1, 1, tzinfo=UTC))

@@ -222,9 +222,9 @@ def test_issuer_too_long_status_skips_futile_redecode():
     pads_seen = []
     orig = leafpack.decode_raw_batch
 
-    def spy(l, e, pad_len, workers=None):
+    def spy(l, e, pad_len, workers=None, threads=None):
         pads_seen.append(pad_len)
-        return orig(l, e, pad_len, workers=workers)
+        return orig(l, e, pad_len, workers=workers, threads=threads)
 
     agg, sink = make_sink(overlap_workers=0, flush_size=64)
     leafpack.decode_raw_batch = spy

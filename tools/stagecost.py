@@ -21,6 +21,11 @@ device-value read. Stage deltas then give real per-stage costs:
             insert + compact readback from host-extracted sidecars;
             compare against `full` — the delta is what the host-side
             sidecar extraction buys the device)
+  decode  — the HOST feed (native wire decode + sidecar extraction),
+            swept over intra-chunk thread counts {1, 2, 4, cpu_count}
+            with byte-exact parity asserted at each point; ns/entry
+            per thread count is the host-feed scaling curve
+            (CT_SC_DECODE_N overrides the wire batch size).
 
 Run:  python tools/stagecost.py [batch] [stage ...]
 """
@@ -271,12 +276,71 @@ def main() -> None:
             f"{dt / batch * 1e9:8.1f} ns/entry  ({n} sweeps)")
         return dt
 
+    def run_decode():
+        """Host decode + sidecar throughput vs intra-chunk threads.
+
+        Pure host work (no device involved): one wire batch decoded at
+        each thread count through the native worker pool, best-of-3,
+        with BYTE-EXACT parity asserted against threads=1 at every
+        point — the scaling number is only meaningful if the parallel
+        split is invisible in the outputs."""
+        from ct_mapreduce_tpu.native import available as nat_available
+        from ct_mapreduce_tpu.native import leafpack
+
+        if not nat_available():
+            say("decode  skipped: native library unavailable")
+            return None
+        n = int(os.environ.get("CT_SC_DECODE_N", str(min(batch, 1 << 16))))
+        tpls = [syncerts.make_template(issuer_cn=f"Decode {k}")
+                for k in range(2)]
+        t0 = time.perf_counter()
+        lis, eds = syncerts.make_wire_batch(tpls, 0, n)
+        say(f"  decode: wire setup {time.perf_counter() - t0:.1f}s "
+            f"({n} entries)")
+        cpu = os.cpu_count() or 1
+        base = None
+        curve = {}
+        for t in sorted({1, 2, 4, cpu}):
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                dec = leafpack.decode_raw_batch(lis, eds, 1024, threads=t)
+                sc = leafpack.extract_sidecars(dec.data, dec.length,
+                                               threads=t)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            if base is None:
+                base = (dec, sc, best)
+            else:
+                for fld in ("data", "length", "timestamp_ms",
+                            "entry_type", "status", "issuer_group"):
+                    assert np.array_equal(
+                        getattr(base[0], fld), getattr(dec, fld)), (
+                        f"decode threads={t}: {fld} diverged from "
+                        "threads=1")
+                assert base[0].group_issuers == dec.group_issuers
+                for fld in vars(base[1]):
+                    assert np.array_equal(
+                        getattr(base[1], fld), getattr(sc, fld)), (
+                        f"sidecar threads={t}: {fld} diverged from "
+                        "threads=1")
+            curve[t] = best
+            speedup = base[2] / best
+            say(f"decode  t={t:<3d} {best * 1e3:9.2f} ms/batch  "
+                f"{best / n * 1e9:8.1f} ns/entry  ({speedup:.2f}x vs t=1, "
+                "parity exact)")
+        return curve
+
     stages = [
         ("read", s_read), ("pack", s_pack), ("pack2", s_pack2),
         ("parse", s_parse),
         ("serial", s_serial), ("sha", s_sha), ("lanes", s_lanes),
     ]
     results = {}
+    if not only or "decode" in only:
+        run_decode()
+    if only == {"decode"}:
+        return
     for name, fn in stages:
         if only and name not in only:
             continue
