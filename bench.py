@@ -919,7 +919,22 @@ def run_smoke() -> dict:
     from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
     from ct_mapreduce_tpu.ingest.sync import AggregatorSink, RawBatch
     from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+    from ct_mapreduce_tpu.telemetry import trace as ttrace
     from ct_mapreduce_tpu.utils import syncerts
+
+    # Stage busy time comes from the span tracer (ingest.decode /
+    # ingest.submit / ingest.drain spans recorded by the pipeline
+    # itself) instead of hand-summed counters: CTMR_TRACE names the
+    # export path, else the smoke traces into a temp file so the gate
+    # below is always span-derived and the trace artifact always
+    # exists for tools/traceview.py.
+    trace_self_enabled = False
+    if not ttrace.enabled():
+        import tempfile
+
+        ttrace.enable(os.path.join(
+            tempfile.gettempdir(), f"ctmr-smoke-trace-{os.getpid()}.json"))
+        trace_self_enabled = True
 
     chunk = int(os.environ.get("CT_BENCH_SMOKE_CHUNK", "1024"))
     n_chunks = int(os.environ.get("CT_BENCH_SMOKE_CHUNKS", "8"))
@@ -956,6 +971,7 @@ def run_smoke() -> dict:
         budget_sink = tmetrics.InMemSink()
         prev = tmetrics.get_sink()
         tmetrics.set_sink(budget_sink)
+        t_us0 = ttrace.now_us()
         try:
             t0 = time.perf_counter()
             for rb in raw_batches:
@@ -965,15 +981,6 @@ def run_smoke() -> dict:
             snap = agg.drain()
             wall = time.perf_counter() - t0
             drain_s = time.perf_counter() - t_drain
-            # Stage busy seconds from the scheduler itself (overlap
-            # runs): decode pool ‖ submit thread ‖ drain consumer.
-            # The submit+drain split is where the device work lands
-            # varies by backend — CPU's synchronous dispatch charges
-            # the jitted step to the SUBMIT envelope, real TPU async
-            # dispatch charges the wait to the drain consumer's
-            # completeBatch — so the device term is their SUM, robust
-            # to either placement.
-            busy = dict(sink._overlap.busy) if sink._overlap else {}
         finally:
             tmetrics.set_sink(prev)
             sink.close()
@@ -982,12 +989,33 @@ def run_smoke() -> dict:
         def s(key):
             return samples.get(f"ct-fetch.{key}", {}).get("sum", 0.0)
 
+        # Span-derived stage busy seconds (this replay's window of the
+        # trace ring): decode pool ‖ submit thread ‖ drain consumer.
+        # The submit+drain split is where the device work lands —
+        # varies by backend: CPU's synchronous dispatch charges the
+        # jitted step to the SUBMIT span, real TPU async dispatch
+        # charges the wait to the drain consumer — so the device term
+        # is their SUM, robust to either placement.
+        t_us1 = ttrace.now_us()
+        spans = [e for e in ttrace.snapshot_events()
+                 if e.get("ph") == "X"
+                 and t_us0 <= e["ts"] and e["ts"] + e["dur"] <= t_us1]
+
+        def span_busy(name):
+            return sum(e["dur"] for e in spans if e["name"] == name) / 1e6
+
         counters = budget_sink.snapshot()["counters"]
+        if overlap and spans:
+            decode_s = span_busy("ingest.decode")
+            device_wait_s = (span_busy("ingest.submit")
+                             + span_busy("ingest.drain"))
+        else:  # serial replays keep the metric-envelope budget
+            decode_s = s("decodeBatch")
+            device_wait_s = s("completeBatch") or s("storeCertificate")
         return {
             "agg": agg, "snap": snap, "wall": wall,
-            "decode_s": busy.get("decode", s("decodeBatch")),
-            "device_wait_s": (busy["submit"] + busy["drain"]
-                              if busy else s("completeBatch")),
+            "decode_s": decode_s,
+            "device_wait_s": device_wait_s,
             "drain_s": drain_s,
             # Via the fill hook: TpuAggregator reads table.count, the
             # sharded leg sums its per-shard counts.
@@ -1197,6 +1225,16 @@ def run_smoke() -> dict:
             f"stage-budget sum {budget_sum:.3f}s (ratio {ratio:.3f}) — "
             "the pipeline is not overlapping its stages")
 
+    # Export the trace the gate was computed from (CTMR_TRACE path, or
+    # the temp file when self-enabled) — tools/traceview.py summarizes
+    # it into the same per-stage occupancy.
+    trace_path = ttrace.export()
+    if trace_path:
+        log(f"smoke trace: {trace_path} "
+            f"(python tools/traceview.py {trace_path})")
+    if trace_self_enabled:
+        ttrace.disable()
+
     return {
         "metric": "ct_e2e_smoke",
         "value": round(total / over["wall"], 1),
@@ -1209,6 +1247,7 @@ def run_smoke() -> dict:
         "smoke_drain_s": round(over["drain_s"], 3),
         "smoke_overlap_ratio": round(ratio, 3),
         "smoke_table_count": over["table_count"],
+        **({"smoke_trace_path": trace_path} if trace_path else {}),
         **({"smoke_preparsed_wall_s": round(pre["wall"], 3),
             "smoke_preparsed_flag_bytes": int(pre["flag_bytes"]),
             "smoke_decode_threads_parity": 1}

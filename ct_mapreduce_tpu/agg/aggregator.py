@@ -54,6 +54,7 @@ from ct_mapreduce_tpu.core import der as hostder
 from ct_mapreduce_tpu.core import packing
 from ct_mapreduce_tpu.core.types import ExpDate, Issuer
 from ct_mapreduce_tpu.ops import buckettable, der_kernel, hashtable, pipeline
+from ct_mapreduce_tpu.telemetry import trace
 from ct_mapreduce_tpu.telemetry.metrics import incr_counter, set_gauge
 
 
@@ -179,7 +180,7 @@ class PendingIngest:
             # is preserved because every completer — the drain consumer
             # and complete_outstanding alike — takes the OLDEST pending
             # first and blocks on its per-pending lock.
-            with agg._fold_lock:
+            with trace.span("device.fold", cat="device"), agg._fold_lock:
                 with contextlib.suppress(ValueError):
                     agg._outstanding.remove(self)
                 agg._inflight_lanes = max(
@@ -248,7 +249,7 @@ class PendingPreparsed:
                 return self._res
             self._done = True
             agg = self._agg
-            with agg._fold_lock:
+            with trace.span("device.fold", cat="device"), agg._fold_lock:
                 with contextlib.suppress(ValueError):
                     agg._outstanding.remove(self)
                 agg._inflight_lanes = max(
@@ -910,7 +911,8 @@ class TpuAggregator:
         step = (pipeline.ingest_step_preparsed
                 if jax.default_backend() == "cpu"
                 else pipeline.ingest_step_preparsed_donated)
-        with self._table_lock:
+        with trace.span("device.step_preparsed", cat="device"), \
+                self._table_lock:
             self.table, out = step(
                 self.table, serials, serial_len, nah, issuer_idx,
                 insertable, np.int32(self.base_hour),
@@ -1198,7 +1200,7 @@ class TpuAggregator:
                 if isinstance(batch.data, jax.Array)
                 and jax.default_backend() != "cpu"
                 else pipeline.ingest_step)
-        with self._table_lock:
+        with trace.span("device.step", cat="device"), self._table_lock:
             self.table, out = step(
                 self.table,
                 batch.data,

@@ -20,6 +20,7 @@ import numpy as np
 from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
 from ct_mapreduce_tpu.agg.sharded import ShardedDedup, shard_of_np
 from ct_mapreduce_tpu.core import packing
+from ct_mapreduce_tpu.telemetry import trace
 
 
 def _pack_bits_np(flags: np.ndarray, nb: int) -> np.ndarray:
@@ -266,7 +267,8 @@ class ShardedAggregator(TpuAggregator):
             return out
 
         cap = min(int(flag_cap), c)
-        with self._table_lock:
+        with trace.span("mesh.step_preparsed", cat="device",
+                        shards=int(ns)), self._table_lock:
             packed_s, ovf_bits_s, counts = self.dedup.step_preparsed(
                 route(ser), route(slen), route(nh), route(ii),
                 route(ins), flag_cap=cap,
@@ -298,15 +300,17 @@ class ShardedAggregator(TpuAggregator):
 
     def _device_step_packed(self, batch):
         self._device_written = True
-        return self.dedup.step(
-            np.asarray(batch.data),
-            np.asarray(batch.length),
-            np.asarray(batch.issuer_idx),
-            np.asarray(batch.valid),
-            now_hour=self._now_hour(),
-            cn_prefixes=self._prefix_arr,
-            cn_prefix_lens=self._prefix_lens,
-        )
+        with trace.span("mesh.step", cat="device",
+                        shards=int(self.dedup.n_shards)):
+            return self.dedup.step(
+                np.asarray(batch.data),
+                np.asarray(batch.length),
+                np.asarray(batch.issuer_idx),
+                np.asarray(batch.valid),
+                now_hour=self._now_hour(),
+                cn_prefixes=self._prefix_arr,
+                cn_prefix_lens=self._prefix_lens,
+            )
 
     def _topology_shards(self) -> int:
         return self.dedup.n_shards

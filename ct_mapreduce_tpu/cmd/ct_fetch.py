@@ -26,6 +26,8 @@ from ct_mapreduce_tpu.ingest.sync import (
     LogSyncEngine,
     polling_delay,
 )
+from ct_mapreduce_tpu.telemetry import flight, trace
+from ct_mapreduce_tpu.telemetry.promhttp import MetricsServer
 from ct_mapreduce_tpu.utils import parse_duration
 
 
@@ -118,6 +120,19 @@ def main(argv: list[str] | None = None) -> int:
 
     database, _cache, _backend = get_configured_storage(config)  # noqa: F841
     dumper = prepare_telemetry("ct-fetch", config)
+    # Span tracing: tracePath directive (CTMR_TRACE env auto-enables at
+    # import). Near-zero cost when off; exported at shutdown.
+    if config.trace_path:
+        trace.enable(config.trace_path)
+    # Flight recorder: a crash, SIGTERM/SIGUSR1, or wedged-pipeline
+    # latch dumps the trace ring + last metric snapshots next to the
+    # run (CTMR_FLIGHT_DIR overrides the directory). Signal dumps ride
+    # this process's own handlers below; the unhandled-exception dump
+    # is the except clause around the main loop (no sys.excepthook
+    # mutation — main() must leave no global hooks behind, it is
+    # re-entered by tests and runForever wrappers). Uninstalled in the
+    # finally for the same reason.
+    flight.install(signals=False, excepthook=False)
     if config.issuer_cn_filter:
         # The reference logs a stale "unsupported" warning here
         # (ct-fetch.go:498-499) but enforces the filter anyway; we just
@@ -125,6 +140,7 @@ def main(argv: list[str] | None = None) -> int:
         print(f"IssuerCNFilter enabled: {config.issuer_cn_filters()}",
               file=sys.stderr)
 
+    run_stage = {"stage": "init"}
     sink, model = build_sink(config, database, _backend)
     checkpoint_hook = None
     if model is not None and config.agg_state_path:
@@ -156,13 +172,54 @@ def main(argv: list[str] | None = None) -> int:
             print(f"health endpoint disabled: {err}", file=sys.stderr)
             health = None
 
+    def healthz() -> dict:
+        """The /healthz body: engine stage, last-progress timestamp,
+        and the overlap pipeline's bounded-queue depths."""
+        updates = engine.last_updates()
+        last = max(updates.values()).isoformat() if updates else None
+        body = {
+            "stage": run_stage["stage"],
+            "last_progress": last,
+            "progress": {u: {"pos": p, "end": e}
+                         for u, (p, e) in engine.progress().items()},
+            "entry_queue_depth": engine.entry_queue.qsize(),
+        }
+        ovl = getattr(sink, "_overlap", None)
+        if ovl is not None:
+            body["overlap_queues"] = ovl.queue_depths()
+        return body
+
+    metrics_server = None
+    if config.metrics_port:
+        try:
+            metrics_server = MetricsServer(
+                config.metrics_port, health=healthz).start()
+            print(f"metrics endpoint: :{metrics_server.port}/metrics "
+                  f"+ /healthz", file=sys.stderr)
+        except OSError as err:
+            print(f"metrics endpoint disabled: {err}", file=sys.stderr)
+            metrics_server = None
+
     def handle_signal(signum, frame):
         print(f"\nsignal {signum}: stopping after current batches...",
               file=sys.stderr)
+        if signum == signal.SIGTERM:
+            # Orchestrator kill: leave the post-mortem artifact before
+            # draining (the drain itself may be what's wedged).
+            flight.dump(f"signal {signum} (SIGTERM)")
         engine.signal_stop()
+
+    def handle_dump_signal(signum, frame):
+        path = flight.dump(f"signal {signum} (SIGUSR1)")
+        print(f"\nsignal {signum}: flight record "
+              f"{path or 'not written'}", file=sys.stderr)
 
     signal.signal(signal.SIGINT, handle_signal)
     signal.signal(signal.SIGTERM, handle_signal)
+    try:
+        signal.signal(signal.SIGUSR1, handle_dump_signal)
+    except (AttributeError, ValueError, OSError):
+        pass  # platform without SIGUSR1 / non-main thread
 
     printer = None
     if not config.nobars:
@@ -186,12 +243,16 @@ def main(argv: list[str] | None = None) -> int:
     final_round_errors = False
     try:
         while True:
+            run_stage["stage"] = "syncing"
             for url in log_urls:
                 engine.sync_log(url)
             engine.wait_for_downloads()
+            run_stage["stage"] = "draining"
             engine.stop()  # drain queue, flush sink
             if model is not None:
+                run_stage["stage"] = "saving"
                 model.save()
+            run_stage["stage"] = "idle"
             # Drain this round's errors so runForever doesn't re-print
             # (or unboundedly accumulate) them across polls.
             final_round_errors = bool(engine.errors)
@@ -207,6 +268,11 @@ def main(argv: list[str] | None = None) -> int:
             )
             if engine.stop_event.wait(delay):
                 break
+    except BaseException as err:
+        # The post-mortem artifact for a crashing run: spans + metric
+        # snapshots as of the moment the main loop died.
+        flight.dump(f"unhandled exception in ct-fetch: {err!r}")
+        raise
     finally:
         if profiling:
             try:
@@ -217,12 +283,20 @@ def main(argv: list[str] | None = None) -> int:
                 # Trace serialization failures must not mask the real
                 # exception or skip the remaining shutdown steps.
                 print(f"profiler stop failed: {err}", file=sys.stderr)
+        run_stage["stage"] = "stopped"
         if printer:
             printer.stop()
         if health:
             health.stop()
+        if metrics_server:
+            metrics_server.stop()
         if dumper:
             dumper.stop()
+        if trace.enabled():
+            path = trace.export()
+            if path:
+                print(f"trace written to {path}", file=sys.stderr)
+        flight.uninstall()
         engine.cleanup()
     return 1 if final_round_errors else 0
 

@@ -62,6 +62,10 @@ class CTConfig:
     device_queue_depth: int = 2
     agg_state_path: str = ""  # .npz snapshot of device aggregates (tpu backend)
     profile_dir: str = ""  # jax.profiler trace output dir (empty = off)
+    trace_path: str = ""  # Chrome trace-event JSON of the ingest spans
+    # (telemetry/trace.py; CTMR_TRACE env equivalent; empty = off)
+    metrics_port: int = 0  # Prometheus /metrics + /healthz HTTP port
+    # (telemetry/promhttp.py; 0 = off)
     verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
@@ -98,6 +102,8 @@ class CTConfig:
         "deviceQueueDepth": ("device_queue_depth", int),
         "aggStatePath": ("agg_state_path", str),
         "profileDir": ("profile_dir", str),
+        "tracePath": ("trace_path", str),
+        "metricsPort": ("metrics_port", int),
     }
 
     @classmethod
@@ -248,6 +254,10 @@ class CTConfig:
             "deviceQueueDepth = host->device prefetch depth",
             "aggStatePath = Path for the on-device aggregate snapshot (.npz)",
             "profileDir = Write a jax.profiler trace of the run here",
+            "tracePath = Write a Chrome trace-event JSON of the ingest "
+            "spans here (CTMR_TRACE env equivalent)",
+            "metricsPort = Serve Prometheus /metrics and /healthz on "
+            "this port (0 disables)",
         ]
         return "\n".join(lines)
 

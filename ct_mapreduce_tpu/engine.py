@@ -21,7 +21,7 @@ from ct_mapreduce_tpu.storage.interfaces import RemoteCache, StorageBackend
 from ct_mapreduce_tpu.storage.localdisk import LocalDiskBackend
 from ct_mapreduce_tpu.storage.mockcache import MockRemoteCache
 from ct_mapreduce_tpu.storage.noop import NoopBackend
-from ct_mapreduce_tpu.telemetry import metrics
+from ct_mapreduce_tpu.telemetry import flight, metrics
 from ct_mapreduce_tpu.telemetry.metrics import InMemSink, MetricsDumper, StatsdSink
 from ct_mapreduce_tpu.utils import parse_duration
 
@@ -50,13 +50,28 @@ def get_configured_storage(
 
 
 def prepare_telemetry(name: str, config: CTConfig) -> Optional[MetricsDumper]:
-    """engine.go:50-86 analog; returns the dumper (if any) so callers
-    can stop it on shutdown."""
-    if config.statsd_host and config.statsd_port:
-        metrics.set_sink(StatsdSink(config.statsd_host, config.statsd_port, f"{name}."))
-        return None
+    """engine.go:50-86 analog; returns the dumper so callers can stop
+    it on shutdown.
+
+    Unlike the reference's either/or (StatsD XOR in-mem dumper), an
+    ``InMemSink`` is ALWAYS the primary sink and StatsD — when
+    configured — rides as a fanout emitter: ``MetricsDumper``, the
+    Prometheus ``/metrics`` endpoint, and the flight recorder all need
+    ``snapshot()``, which ``StatsdSink`` cannot provide. The dumper's
+    periodic snapshots also feed the flight recorder's last-N ring
+    (a no-op until ``flight.install`` runs)."""
     sink = InMemSink()
-    metrics.set_sink(sink)
-    dumper = MetricsDumper(sink, parse_duration(config.stats_refresh_period))
+    if config.statsd_host and config.statsd_port:
+        metrics.set_sink(
+            sink,
+            StatsdSink(config.statsd_host, config.statsd_port, f"{name}."),
+        )
+    else:
+        metrics.set_sink(sink)
+    dumper = MetricsDumper(
+        sink,
+        parse_duration(config.stats_refresh_period),
+        on_snapshot=flight.record_snapshot,
+    )
     dumper.start()
     return dumper

@@ -47,7 +47,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
-from ct_mapreduce_tpu.telemetry import metrics
+from ct_mapreduce_tpu.telemetry import flight, metrics, trace
 
 
 class OverlapError(RuntimeError):
@@ -229,11 +229,29 @@ class OverlapIngestPipeline:
                           value=float(self.queue_depth))
         return hw
 
+    def queue_depths(self) -> dict[str, int]:
+        """Instantaneous bounded-queue depths (plus caps and high-water
+        marks) — the ``/healthz`` surface for telling a decode-starved
+        pipeline from a drain-starved one while it runs."""
+        with self._hw_lock:
+            prepared = self._prepared_in_use
+            hw = dict(self.highwater)
+        return {
+            "prepared": prepared,
+            "prepared_capacity": self._max_prepared,
+            "prepared_highwater": hw["prepared"],
+            "drain_queue": self._drain_q.qsize(),
+            "drain_queue_capacity": self.queue_depth,
+            "drain_queue_highwater": hw["drain_queue"],
+        }
+
     # -- stage bodies ----------------------------------------------------
     def _decode_one(self, pairs):
         t0 = time.perf_counter()
         try:
-            return self._sink._prepare_chunk(pairs)
+            with trace.span("ingest.decode", cat="ingest",
+                            entries=len(pairs)):
+                return self._sink._prepare_chunk(pairs)
         finally:
             self._add_busy("decode", time.perf_counter() - t0)
 
@@ -262,14 +280,16 @@ class OverlapIngestPipeline:
             # gauge / the bench's e2e dispatch budget.
             t_lock = time.perf_counter()
             try:
-                with self._sink._dispatch_lock:
+                with trace.span("ingest.submit_locked", cat="ingest"), \
+                        self._sink._dispatch_lock:
                     lock_s = time.perf_counter() - t_lock
                     self._add_busy("lock", lock_s)
                     metrics.add_sample("ct-fetch", "dispatchLockWait",
                                        value=lock_s)
                     t0 = time.perf_counter()
                     try:
-                        with metrics.measure("ct-fetch", "storeCertificate"):
+                        with metrics.measure("ct-fetch", "storeCertificate"), \
+                                trace.span("ingest.submit", cat="ingest"):
                             work = self._sink._submit_chunk(prep)
                     finally:
                         self._add_busy("submit", time.perf_counter() - t0)
@@ -296,10 +316,11 @@ class OverlapIngestPipeline:
             kind, payload, der_of = item
             t0 = time.perf_counter()
             try:
-                if kind == "pending":
-                    self._sink._complete_item(payload, der_of)
-                else:  # "result": oversized exact lane, already folded
-                    self._sink._store_pems(payload, der_of)
+                with trace.span("ingest.drain", cat="ingest"):
+                    if kind == "pending":
+                        self._sink._complete_item(payload, der_of)
+                    else:  # "result": oversized exact lane, already folded
+                        self._sink._store_pems(payload, der_of)
             except BaseException as err:
                 self._fail(err)
             finally:
@@ -316,11 +337,21 @@ class OverlapIngestPipeline:
             self.busy[stage] += seconds
 
     def _fail(self, err: BaseException) -> None:
+        first = False
         with self._exc_lock:
             if self._exc is None:
                 self._exc = err
+                first = True
         self._failed.set()
         metrics.incr_counter("overlap", "stage_error")
+        if first:
+            # Latch-time post-mortem: the FIRST stage failure dumps the
+            # trace ring + metric snapshots (no-op unless a flight
+            # recorder is installed), so a wedged or crashed run leaves
+            # an artifact even if the OverlapError never surfaces.
+            trace.instant("overlap.stage_error", cat="ingest",
+                          error=repr(err)[:500])
+            flight.dump(f"overlap stage failure: {err!r}")
 
     def _raise_if_failed(self) -> None:
         if self._failed.is_set():

@@ -46,7 +46,7 @@ from ct_mapreduce_tpu.ingest.leaf import (
     decode_json_entry,
     leaf_timestamp_ms as decode_leaf_timestamp,
 )
-from ct_mapreduce_tpu.telemetry import metrics
+from ct_mapreduce_tpu.telemetry import metrics, trace
 
 ENTRY_QUEUE_CAPACITY = 16384  # ct-fetch.go:132
 
@@ -232,16 +232,19 @@ class AggregatorSink:
             # ordered submit thread, completion on its drain consumer.
             self._overlap.submit_chunk(pairs)
             return
-        prep = self._prepare_chunk(pairs)
+        with trace.span("ingest.decode", cat="ingest", entries=len(pairs)):
+            prep = self._prepare_chunk(pairs)
         t_lock = time.monotonic()
-        with self._dispatch_lock:
+        with trace.span("ingest.submit_locked", cat="ingest"), \
+                self._dispatch_lock:
             # Lock wait sampled apart from the storeCertificate
             # envelope (see ingest/overlap.py's submit loop): multiple
             # store workers contend here, and the wait is not submit
             # work.
             metrics.add_sample("ct-fetch", "dispatchLockWait",
                                value=time.monotonic() - t_lock)
-            with metrics.measure("ct-fetch", "storeCertificate"):
+            with metrics.measure("ct-fetch", "storeCertificate"), \
+                    trace.span("ingest.submit", cat="ingest"):
                 self._dispatch_prepared(prep)
 
     def _dispatch_prepared(self, prep: "_PreparedChunk") -> None:
@@ -475,7 +478,8 @@ class AggregatorSink:
         really lives: device execution + D2H readback + the exact
         host-lane work for flagged lanes — the counterpart of the
         (async-enqueue) storeCertificate/h2dSubmit samples."""
-        with metrics.measure("ct-fetch", "completeBatch"):
+        with metrics.measure("ct-fetch", "completeBatch"), \
+                trace.span("device.readback", cat="device"):
             res = pending.complete()
         self._store_pems(res, der_of)
 

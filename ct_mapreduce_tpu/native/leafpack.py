@@ -18,6 +18,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ct_mapreduce_tpu.native import load as load_native
+from ct_mapreduce_tpu.telemetry import trace
 
 # Status codes — keep in sync with ctmr_native.cpp.
 OK = 0
@@ -119,6 +120,13 @@ def resolve_threads(n: int, threads: Optional[int] = None) -> int:
 
 def extract_sidecars(data: np.ndarray, length: np.ndarray,
                      threads: Optional[int] = None) -> Optional[Sidecar]:
+    with trace.span("native.extract_sidecars", cat="native",
+                    entries=int(data.shape[0])):
+        return _extract_sidecars(data, length, threads)
+
+
+def _extract_sidecars(data: np.ndarray, length: np.ndarray,
+                      threads: Optional[int] = None) -> Optional[Sidecar]:
     """Pre-parsed sidecars for packed rows ``uint8[n, pad]`` +
     ``int32[n]`` lengths, or None when the native library is
     unavailable (callers then stay on the device-walker lane —
@@ -196,6 +204,19 @@ def _concat_b64(strings: Sequence[str]) -> tuple[bytes, np.ndarray]:
 
 
 def decode_raw_batch(
+    leaf_inputs: Sequence[str],
+    extra_datas: Sequence[str],
+    pad_len: int,
+    workers: Optional[int] = None,
+    threads: Optional[int] = None,
+) -> DecodedBatch:
+    with trace.span("native.decode_batch", cat="native",
+                    entries=len(leaf_inputs), pad=int(pad_len)):
+        return _decode_raw_batch(leaf_inputs, extra_datas, pad_len,
+                                 workers=workers, threads=threads)
+
+
+def _decode_raw_batch(
     leaf_inputs: Sequence[str],
     extra_datas: Sequence[str],
     pad_len: int,
@@ -416,6 +437,12 @@ def _decode_native_mt(
 
 def pack_ders(ders: Sequence[bytes], pad_len: int,
               threads: Optional[int] = None):
+    with trace.span("native.pack_ders", cat="native", entries=len(ders)):
+        return _pack_ders(ders, pad_len, threads)
+
+
+def _pack_ders(ders: Sequence[bytes], pad_len: int,
+               threads: Optional[int] = None):
     """Pack pre-decoded DER blobs into the ``[n, pad_len]`` device
     layout via the native packer (parallel over lane ranges when
     ``threads`` > 1); returns ``(data, length, ok, packed_count)`` or

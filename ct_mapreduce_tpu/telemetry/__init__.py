@@ -1,4 +1,4 @@
-from ct_mapreduce_tpu.telemetry import metrics  # noqa: F401
+from ct_mapreduce_tpu.telemetry import metrics, trace  # noqa: F401
 from ct_mapreduce_tpu.telemetry.metrics import (  # noqa: F401
     InMemSink,
     MetricsDumper,

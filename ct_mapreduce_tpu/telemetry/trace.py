@@ -1,0 +1,282 @@
+"""Span tracing: a lock-cheap, thread-aware, ring-buffered tracer for
+the ingest hot path, exporting Chrome trace-event JSON.
+
+Design constraints (the hot path dispatches ~1M-entry chunks, so spans
+are per-CHUNK, but the disabled path must still cost nothing):
+
+- **Disabled = one global read.** ``span()`` reads one module global;
+  when no tracer is installed it returns a shared no-op context
+  manager — no allocation, no lock, no branch beyond the None check.
+- **Enabled = GIL-atomic appends.** Events land in a
+  ``collections.deque(maxlen=ring)`` whose ``append`` is atomic under
+  the GIL, so concurrent stage threads (decode pool, submit, drain)
+  never contend on a lock in ``__exit__``. The ring bound (default
+  2^16 events, ``CTMR_TRACE_RING``) means a week-long ``runForever``
+  deployment keeps the LAST window of activity instead of growing
+  without limit — exactly what the flight recorder wants.
+- **Chrome trace-event format.** Export is the Trace Event Format's
+  JSON-object form (``{"traceEvents": [...]}``): complete spans
+  (``ph="X"`` with ``ts``/``dur`` in microseconds), instant events
+  (``ph="i"``), and thread-name metadata (``ph="M"``) — loadable in
+  Perfetto / ``chrome://tracing`` as-is, and summarizable offline by
+  ``tools/traceview.py``.
+- **Optional XLA alignment.** ``jax_annotations=True`` (or
+  ``CTMR_TRACE_JAX=1``) additionally enters a
+  ``jax.profiler.TraceAnnotation`` per span, so when a jax profiler
+  trace (``profileDir``) runs alongside, the host-side stage spans
+  line up with the device timeline in the same viewer.
+
+Enabling: the ``CTMR_TRACE=<path>`` environment variable (read at
+import, so every entry point — ct-fetch, bench, tests — gets it for
+free) or the ``tracePath`` config directive / an explicit
+:func:`enable` call. When a path is set, the ring is exported there at
+interpreter exit; callers may also :func:`export` eagerly.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+DEFAULT_RING = 1 << 16  # events; ~25 MB worst case, bounds long runs
+
+
+class _NullSpan:
+    """Shared no-op context manager: the disabled-tracing fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span; records a complete ("X") event on exit."""
+
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_t0", "_ann")
+
+    def __init__(self, tracer: "SpanTracer", name: str, cat: str, args):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+        self._ann = None
+
+    def __enter__(self):
+        if self._tracer.jax_annotations:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._ann = TraceAnnotation(self._name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None  # tracing must never break the pipeline
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:
+                pass
+        self._tracer._complete(self._name, self._cat, self._t0, t1,
+                               self._args)
+        return False
+
+
+class SpanTracer:
+    def __init__(self, path: Optional[str] = None,
+                 ring_size: int = DEFAULT_RING,
+                 jax_annotations: bool = False):
+        self.path = path or None
+        self.ring_size = max(16, int(ring_size))
+        self.jax_annotations = bool(jax_annotations)
+        # deque.append is GIL-atomic: the hot path never takes a lock.
+        self._events: deque = deque(maxlen=self.ring_size)
+        self._t0_ns = time.perf_counter_ns()
+        # Wall-clock anchor so post-mortem readers can place the
+        # monotonic timestamps in real time.
+        self.wall_t0 = time.time()
+        self._pid = os.getpid()
+        self._threads_lock = threading.Lock()
+        self._thread_names: dict[int, str] = {}
+
+    # -- recording -------------------------------------------------------
+    def now_us(self) -> float:
+        """Current timestamp on the tracer's own clock (µs since
+        construction) — for callers windowing :meth:`events`."""
+        return (time.perf_counter_ns() - self._t0_ns) / 1e3
+
+    def _tid(self) -> int:
+        tid = threading.get_ident()
+        if tid not in self._thread_names:
+            with self._threads_lock:
+                self._thread_names.setdefault(
+                    tid, threading.current_thread().name)
+        return tid
+
+    def _complete(self, name: str, cat: str, t0_ns: int, t1_ns: int,
+                  args) -> None:
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": (t0_ns - self._t0_ns) / 1e3,
+            "dur": max(t1_ns - t0_ns, 0) / 1e3,
+            "pid": self._pid,
+            "tid": self._tid(),
+        }
+        if cat:
+            ev["cat"] = cat
+        if args:
+            ev["args"] = dict(args)
+        self._events.append(ev)
+
+    def span(self, name: str, cat: str = "", **args) -> _Span:
+        return _Span(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "", **args) -> None:
+        ev = {
+            "name": name,
+            "ph": "i",
+            "s": "t",  # thread-scoped instant
+            "ts": self.now_us(),
+            "pid": self._pid,
+            "tid": self._tid(),
+        }
+        if cat:
+            ev["cat"] = cat
+        if args:
+            ev["args"] = dict(args)
+        self._events.append(ev)
+
+    # -- reading / export ------------------------------------------------
+    def events(self) -> list[dict]:
+        """Ring contents plus thread-name metadata, oldest first."""
+        with self._threads_lock:
+            meta = [
+                {"name": "thread_name", "ph": "M", "pid": self._pid,
+                 "tid": tid, "args": {"name": tname}}
+                for tid, tname in sorted(self._thread_names.items())
+            ]
+        return meta + list(self._events)
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def export(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Chrome trace JSON; returns the path (None if no
+        path is known). Never raises — an unwritable trace file must
+        not take down the run it describes."""
+        path = path or self.path
+        if not path:
+            return None
+        doc = {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+            "otherData": {"wall_t0": self.wall_t0,
+                          "ring_size": self.ring_size},
+        }
+        try:
+            with open(path, "w") as fh:
+                json.dump(doc, fh)
+        except OSError:
+            return None
+        return path
+
+
+# -- module-level tracer (the hot path reads one global) ----------------
+
+_tracer: Optional[SpanTracer] = None
+_atexit_registered = False
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def get_tracer() -> Optional[SpanTracer]:
+    return _tracer
+
+
+def enable(path: Optional[str] = None, ring_size: Optional[int] = None,
+           jax_annotations: Optional[bool] = None) -> SpanTracer:
+    """Install the global tracer (idempotent: re-enabling with a path
+    updates the export path of the live tracer rather than dropping
+    its ring)."""
+    global _tracer, _atexit_registered
+    if ring_size is None:
+        ring_size = int(os.environ.get("CTMR_TRACE_RING", DEFAULT_RING))
+    if jax_annotations is None:
+        jax_annotations = os.environ.get("CTMR_TRACE_JAX", "0") == "1"
+    if _tracer is None:
+        _tracer = SpanTracer(path=path, ring_size=ring_size,
+                             jax_annotations=jax_annotations)
+    else:
+        if path:
+            _tracer.path = path
+        if jax_annotations:
+            _tracer.jax_annotations = True
+    if not _atexit_registered:
+        atexit.register(_export_at_exit)
+        _atexit_registered = True
+    return _tracer
+
+
+def disable() -> None:
+    global _tracer
+    _tracer = None
+
+
+def _export_at_exit() -> None:
+    t = _tracer
+    if t is not None and t.path:
+        t.export()
+
+
+def span(name: str, cat: str = "", **args):
+    """A span context manager; the shared no-op when tracing is off."""
+    t = _tracer
+    if t is None:
+        return _NULL_SPAN
+    return t.span(name, cat, **args)
+
+
+def instant(name: str, cat: str = "", **args) -> None:
+    t = _tracer
+    if t is not None:
+        t.instant(name, cat, **args)
+
+
+def now_us() -> float:
+    t = _tracer
+    return t.now_us() if t is not None else 0.0
+
+
+def snapshot_events() -> list[dict]:
+    """Current ring contents (for the flight recorder); [] when off."""
+    t = _tracer
+    return t.events() if t is not None else []
+
+
+def export(path: Optional[str] = None) -> Optional[str]:
+    t = _tracer
+    return t.export(path) if t is not None else None
+
+
+# CTMR_TRACE=<path> enables tracing for any entry point at import time.
+_env_path = os.environ.get("CTMR_TRACE", "")
+if _env_path:
+    enable(_env_path)

@@ -113,3 +113,25 @@ def test_usage_mentions_every_reference_directive():
         "healthAddr",
     ):
         assert directive in text, f"usage() missing {directive}"
+
+
+def test_observability_directives(tmp_path):
+    """tracePath / metricsPort (PR 4): ini + env layering, ints parse,
+    and usage() documents both."""
+    ini = tmp_path / "ct.ini"
+    ini.write_text("tracePath = /tmp/run-trace.json\nmetricsPort = 9464\n")
+    cfg = CTConfig.load(argv=["--config", str(ini)], env={})
+    assert cfg.trace_path == "/tmp/run-trace.json"
+    assert cfg.metrics_port == 9464
+    # Env beats file; unparseable env falls back to the file value.
+    cfg2 = CTConfig.load(argv=["--config", str(ini)],
+                         env={"metricsPort": "9000"})
+    assert cfg2.metrics_port == 9000
+    cfg3 = CTConfig.load(argv=["--config", str(ini)],
+                         env={"metricsPort": "banana"})
+    assert cfg3.metrics_port == 9464
+    # Defaults: both off.
+    off = CTConfig.load(argv=[], env={})
+    assert off.trace_path == "" and off.metrics_port == 0
+    usage = CTConfig().usage()
+    assert "tracePath" in usage and "metricsPort" in usage

@@ -1,0 +1,126 @@
+"""Summarize a Chrome trace-event JSON (telemetry/trace.py output)
+into per-stage occupancy and gap statistics.
+
+The question a pipelined-ingest trace answers is "which stage starved
+which": per span name this prints span count, total busy seconds,
+occupancy (busy / trace wall), and the largest gap between consecutive
+spans of that stage — a stage with low occupancy and large gaps is
+waiting on its upstream; stages whose occupancies sum past 1.0 are
+genuinely overlapping.
+
+Usage:
+  python tools/traceview.py /tmp/trace.json [--stages name1,name2,...]
+
+Also importable: ``load(path)`` / ``stage_summary(events)`` are the
+parsing half of bench.py's span-derived smoke occupancy and of
+tests/test_trace.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load(path: str) -> list[dict]:
+    """Read trace events from either JSON form (object with
+    ``traceEvents`` or a bare event array)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict):
+        return doc.get("traceEvents", [])
+    if isinstance(doc, list):
+        return doc
+    raise ValueError(f"{path}: not a Chrome trace-event JSON")
+
+
+def complete_spans(events: list[dict]) -> list[dict]:
+    """The duration ("X") events, sorted by start timestamp."""
+    return sorted(
+        (e for e in events if e.get("ph") == "X"),
+        key=lambda e: (e.get("ts", 0.0), -e.get("dur", 0.0)),
+    )
+
+
+def stage_summary(events: list[dict], stages=None,
+                  t0_us: float = None, t1_us: float = None) -> dict:
+    """Per-name span statistics over ``events`` (optionally windowed
+    to [t0_us, t1_us] and filtered to ``stages``).
+
+    Returns ``{name: {"count", "busy_s", "first_us", "last_us",
+    "max_gap_s", "occupancy"}}`` plus a ``"_wall_s"`` entry — the span
+    of the whole selection, the denominator of every occupancy.
+    Same-name spans never self-nest in this codebase, so per-name busy
+    is a plain duration sum (distinct-name nesting does not
+    double-count within a name).
+    """
+    spans = complete_spans(events)
+    if t0_us is not None:
+        spans = [e for e in spans if e["ts"] >= t0_us]
+    if t1_us is not None:
+        spans = [e for e in spans if e["ts"] + e.get("dur", 0.0) <= t1_us]
+    if stages is not None:
+        stages = set(stages)
+        spans = [e for e in spans if e["name"] in stages]
+    if not spans:
+        return {"_wall_s": 0.0}
+    wall_us = (max(e["ts"] + e.get("dur", 0.0) for e in spans)
+               - min(e["ts"] for e in spans))
+    by_name: dict[str, list[dict]] = defaultdict(list)
+    for e in spans:
+        by_name[e["name"]].append(e)
+    out: dict = {"_wall_s": wall_us / 1e6}
+    for name, evs in by_name.items():
+        busy_us = sum(e.get("dur", 0.0) for e in evs)
+        max_gap = 0.0
+        prev_end = None
+        for e in evs:  # already ts-sorted
+            if prev_end is not None:
+                max_gap = max(max_gap, e["ts"] - prev_end)
+            prev_end = max(prev_end or 0.0, e["ts"] + e.get("dur", 0.0))
+        out[name] = {
+            "count": len(evs),
+            "busy_s": busy_us / 1e6,
+            "first_us": evs[0]["ts"],
+            "last_us": prev_end,
+            "max_gap_s": max_gap / 1e6,
+            "occupancy": (busy_us / wall_us) if wall_us > 0 else 0.0,
+        }
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome trace-event JSON path")
+    ap.add_argument("--stages", default="",
+                    help="comma-separated span names to include "
+                         "(default: all)")
+    args = ap.parse_args(argv)
+    stages = [s for s in args.stages.split(",") if s] or None
+    events = load(args.trace)
+    summary = stage_summary(events, stages=stages)
+    wall = summary.pop("_wall_s")
+    if not summary:
+        print("no complete spans in trace", file=sys.stderr)
+        return 1
+    print(f"trace wall: {wall:.3f}s over "
+          f"{sum(s['count'] for s in summary.values())} spans")
+    hdr = f"{'stage':<28} {'count':>7} {'busy_s':>9} {'occ':>6} {'max_gap_s':>10}"
+    print(hdr)
+    print("-" * len(hdr))
+    occ_sum = 0.0
+    for name in sorted(summary, key=lambda n: -summary[n]["busy_s"]):
+        s = summary[name]
+        occ_sum += s["occupancy"]
+        print(f"{name:<28} {s['count']:>7} {s['busy_s']:>9.3f} "
+              f"{s['occupancy']:>6.2f} {s['max_gap_s']:>10.3f}")
+    print(f"{'(sum)':<28} {'':>7} {'':>9} {occ_sum:>6.2f}")
+    if occ_sum > 1.05:
+        print("occupancies sum past 1.0: stages are overlapping")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
