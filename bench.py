@@ -1213,6 +1213,191 @@ def run_smoke() -> dict:
         pre = shp = None
         log("smoke preparsed leg skipped: native library unavailable")
 
+    # (2e) serve leg: the query plane (ISSUE 5) over the overlapped
+    # aggregator, WHILE a background thread keeps ingesting fresh
+    # serials — parity against the known fed/absent truth, dynamic
+    # batching effectiveness from the serve.batch spans, a span-derived
+    # p99 wait bound, and an explicit load-shed gate.
+    import threading as _threading
+    import urllib.request as _urlreq
+
+    from ct_mapreduce_tpu.core import der as _hostder
+    from ct_mapreduce_tpu.core.types import ExpDate as _ExpDate
+    from ct_mapreduce_tpu.core.types import Issuer as _Issuer
+    from ct_mapreduce_tpu.serve.batcher import MicroBatcher, Overloaded
+    from ct_mapreduce_tpu.serve.server import QueryServer
+
+    agg = over["agg"]
+    idents = []
+    for tpl in tpls:
+        iss_id = _Issuer.from_spki(
+            _hostder.parse_cert(tpl.issuer_der).spki).id()
+        eh = _hostder.parse_cert(tpl.leaf_der).not_after_unix_hour
+        idents.append((iss_id, _ExpDate.from_unix_hour(eh).id()))
+
+    def q_of(j):
+        k = j % 2
+        tpl = tpls[k]
+        der = syncerts.stamp_serial(tpl, j)
+        return {
+            "issuer": idents[k][0], "expDate": idents[k][1],
+            "serial": der[
+                tpl.serial_off : tpl.serial_off + tpl.serial_len].hex(),
+        }
+
+    serve_delay = 0.003
+    t_sv0 = ttrace.now_us()
+    srv = QueryServer(agg, 0, host="127.0.0.1", max_batch=256,
+                      max_delay_s=serve_delay, max_staleness_s=0.5).start()
+    ingest_stop = _threading.Event()
+
+    def bg_ingest():
+        # Fresh serials [total, 2·total): the table keeps stepping (and
+        # possibly growing) underneath the pinned views.
+        j0 = total
+        while not ingest_stop.is_set() and j0 < 2 * total:
+            entries = [(syncerts.stamp_serial(tpls[j % 2], j),
+                        tpls[j % 2].issuer_der)
+                       for j in range(j0, j0 + 256)]
+            agg.ingest(entries)
+            j0 += 256
+
+    lat: list[float] = []
+    mism: list = []
+
+    def http_client(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(12):
+            pres = [int(rng.integers(total)) for _ in range(3)]
+            # [3·total, 4·total): never fed by any leg, must be absent.
+            absent = [int(rng.integers(3 * total, 4 * total))]
+            body = json.dumps(
+                {"queries": [q_of(j) for j in pres + absent]}).encode()
+            req = _urlreq.Request(
+                f"http://127.0.0.1:{srv.port}/query", data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            with _urlreq.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+            lat.append(time.perf_counter() - t0)  # GIL-atomic append
+            got = [r["known"] for r in out["results"]]
+            if got != [True, True, True, False]:
+                mism.append((pres + absent, got))
+
+    def burst_client(seed):
+        # In-process single-lane floods: the cross-request coalescing
+        # load (16 concurrent single queries MUST merge into batches).
+        rng = np.random.default_rng(1000 + seed)
+        iss_idx = agg.registry.index_of_issuer_id(idents[0][0])
+        eh = _hostder.parse_cert(tpls[0].leaf_der).not_after_unix_hour
+        for _ in range(18):
+            j = int(rng.integers(0, total, endpoint=False)) & ~1  # tpl 0
+            der = syncerts.stamp_serial(tpls[0], j)
+            sb = der[tpls[0].serial_off:
+                     tpls[0].serial_off + tpls[0].serial_len]
+            res = srv.oracle.query_raw([(iss_idx, eh, sb)])
+            if not res[0][0]:
+                mism.append(("burst", j))
+
+    bg = _threading.Thread(target=bg_ingest)
+    bg.start()
+    clients = ([_threading.Thread(target=http_client, args=(s,))
+                for s in range(4)]
+               + [_threading.Thread(target=burst_client, args=(s,))
+                  for s in range(12)])
+    t_serve0 = time.perf_counter()
+    for c in clients:
+        c.start()
+    for c in clients:
+        c.join()
+    serve_wall = time.perf_counter() - t_serve0
+    ingest_stop.set()
+    bg.join()
+    srv.stop()
+    t_sv1 = ttrace.now_us()
+    if mism:
+        raise BenchError(
+            f"smoke serve parity: {len(mism)} wrong answers, first "
+            f"{mism[0]} — queries during concurrent ingest are not "
+            "snapshot-consistent")
+    spans = [e for e in ttrace.snapshot_events()
+             if e.get("ph") == "X" and t_sv0 <= e["ts"] <= t_sv1]
+    batch_spans = [e for e in spans if e["name"] == "serve.batch"]
+    wait_spans = [e for e in spans if e["name"] == "serve.wait"]
+    if not batch_spans or not wait_spans:
+        raise BenchError(
+            "smoke serve: no serve.batch/serve.wait spans — the serve "
+            "path is not traced")
+    mean_lanes = (sum(e["args"]["lanes"] for e in batch_spans)
+                  / len(batch_spans))
+    max_requests = max(e["args"]["requests"] for e in batch_spans)
+    if mean_lanes <= 1.0:
+        raise BenchError(
+            f"smoke serve batching: mean lanes/batch {mean_lanes:.2f} "
+            "<= 1 — the batcher is not forming batches")
+    if max_requests <= 1:
+        raise BenchError(
+            "smoke serve batching: no batch ever coalesced more than "
+            "one request — dynamic batching is not happening")
+    max_batch_s = max(e["dur"] for e in batch_spans) / 1e6
+    waits = sorted(e["dur"] / 1e6 for e in wait_spans)
+    p50_wait = waits[len(waits) // 2]
+    p99_wait = waits[min(len(waits) - 1, int(0.99 * len(waits)))]
+    # A waiter sees: its batch forming (<= max_delay) + at most one
+    # in-flight batch draining + its own batch executing.
+    wait_budget = serve_delay + 2 * max_batch_s + 0.1
+    if p99_wait > wait_budget:
+        raise BenchError(
+            f"smoke serve wait: p99 {p99_wait * 1e3:.1f}ms > max_delay "
+            f"+ 2x batch execution + slack ({wait_budget * 1e3:.1f}ms)")
+    lat.sort()
+    serve_lanes = 4 * len(lat) + 12 * 18
+    log(f"smoke serve: {len(lat)} http requests + {12 * 18} burst "
+        f"queries in {serve_wall:.2f}s ({serve_lanes / serve_wall:,.0f} "
+        f"lanes/s), {len(batch_spans)} batches, mean {mean_lanes:.1f} "
+        f"lanes/batch (max {max_requests} reqs), wait p50 "
+        f"{p50_wait * 1e3:.1f}ms p99 {p99_wait * 1e3:.1f}ms")
+
+    # Load-shed gate: a stalled oracle behind a 4-lane admission queue
+    # must reject loudly — and every admitted request still answers.
+    hold = _threading.Event()
+
+    def slow_oracle(items):
+        hold.wait(timeout=10)
+        return [True] * len(items)
+
+    shed_b = MicroBatcher(slow_oracle, max_batch=8, max_delay_s=0.001,
+                          max_queue_lanes=4)
+    shed_ok: list[int] = []
+    shed_rej: list[int] = []
+
+    def shed_client(k):
+        try:
+            shed_b.submit([k])
+            shed_ok.append(k)
+        except Overloaded:
+            shed_rej.append(k)
+
+    shed_threads = [_threading.Thread(target=shed_client, args=(k,))
+                    for k in range(16)]
+    for t in shed_threads:
+        t.start()
+        time.sleep(0.002)
+    hold.set()
+    for t in shed_threads:
+        t.join()
+    shed_b.close()
+    if not shed_rej:
+        raise BenchError(
+            "smoke serve shed: 16 requests against a 4-lane queue with "
+            "a stalled oracle produced zero overloaded rejections")
+    if not shed_ok or len(shed_ok) + len(shed_rej) != 16:
+        raise BenchError(
+            f"smoke serve shed: admitted {len(shed_ok)} + shed "
+            f"{len(shed_rej)} != 16 — requests lost")
+    log(f"smoke serve shed leg: {len(shed_rej)}/16 rejected overloaded, "
+        f"{len(shed_ok)} served after the stall")
+
     # (3) the overlap inequality, on the overlapped run itself.
     budget_sum = over["decode_s"] + over["device_wait_s"] + over["drain_s"]
     ratio = over["wall"] / budget_sum if budget_sum > 0 else 99.0
@@ -1247,6 +1432,14 @@ def run_smoke() -> dict:
         "smoke_drain_s": round(over["drain_s"], 3),
         "smoke_overlap_ratio": round(ratio, 3),
         "smoke_table_count": over["table_count"],
+        "smoke_serve_parity": 1,
+        "smoke_serve_lanes_per_s": round(serve_lanes / serve_wall, 1),
+        "smoke_serve_batches": len(batch_spans),
+        "smoke_serve_mean_batch_lanes": round(mean_lanes, 2),
+        "smoke_serve_max_batch_requests": max_requests,
+        "smoke_serve_wait_p50_ms": round(p50_wait * 1e3, 2),
+        "smoke_serve_wait_p99_ms": round(p99_wait * 1e3, 2),
+        "smoke_serve_shed": len(shed_rej),
         **({"smoke_trace_path": trace_path} if trace_path else {}),
         **({"smoke_preparsed_wall_s": round(pre["wall"], 3),
             "smoke_preparsed_flag_bytes": int(pre["flag_bytes"]),

@@ -187,7 +187,29 @@ def main(argv: list[str] | None = None) -> int:
         ovl = getattr(sink, "_overlap", None)
         if ovl is not None:
             body["overlap_queues"] = ovl.queue_depths()
+        if query_server is not None:
+            body["serve"] = query_server.oracle.stats()
         return body
+
+    # Query plane: the batched membership-oracle JSON API over the live
+    # aggregator (serve/server.py). TPU backend only — the oracle pins
+    # epochs of the device dedup table; the per-entry database path has
+    # no device table to serve.
+    query_server = None
+    if config.query_port and model is not None:
+        from ct_mapreduce_tpu.serve.server import QueryServer
+
+        try:
+            query_server = QueryServer(
+                model.aggregator, config.query_port).start()
+            print(f"query endpoint: :{query_server.port}/query "
+                  f"+ /issuer + /getcert", file=sys.stderr)
+        except OSError as err:
+            print(f"query endpoint disabled: {err}", file=sys.stderr)
+            query_server = None
+    elif config.query_port:
+        print("queryPort ignored: the query plane needs backend = tpu",
+              file=sys.stderr)
 
     metrics_server = None
     if config.metrics_port:
@@ -290,6 +312,8 @@ def main(argv: list[str] | None = None) -> int:
             health.stop()
         if metrics_server:
             metrics_server.stop()
+        if query_server:
+            query_server.stop()
         if dumper:
             dumper.stop()
         if trace.enabled():

@@ -14,6 +14,7 @@ of walking a Redis keyspace — same report, no per-key round trips.
 from __future__ import annotations
 
 import sys
+from typing import Optional
 
 from ct_mapreduce_tpu.config import CTConfig
 from ct_mapreduce_tpu.engine import get_configured_storage, prepare_telemetry
@@ -172,6 +173,124 @@ def print_log_status(config: CTConfig, database, out) -> None:
             print(str(state), file=out)
 
 
+def _log_status_lines(config: CTConfig, database) -> list[str]:
+    """The "Log status:" walk as data (shared by text and JSON modes;
+    same string-length gate as the reference, :86-90)."""
+    from ct_mapreduce_tpu.ingest.ctclient import short_url
+
+    lines = []
+    if config.log_url_list and len(config.log_url_list) > 5:
+        for url in config.log_urls():
+            lines.append(str(database.get_log_state(short_url(url))))
+    return lines
+
+
+def collect_tpu_report(config: CTConfig) -> Optional[dict]:
+    """Machine-readable form of :func:`report_from_tpu_snapshot` —
+    the same drain, the same numbers, as a JSON-serializable dict
+    (text/JSON parity is pinned by tests/test_cmd.py). Returns None
+    when the snapshot is missing (the text path's error case)."""
+    import os
+
+    from ct_mapreduce_tpu.agg.aggregator import HostSnapshotAggregator
+
+    path = config.agg_state_path
+    if not path or not os.path.exists(path):
+        return None
+    agg = HostSnapshotAggregator(capacity=1 << 10)
+    agg.load_checkpoint(path)
+    snap = agg.drain()
+
+    by_issuer: dict[str, dict[str, int]] = {}
+    for (iss, exp), count in snap.counts.items():
+        by_issuer.setdefault(iss, {})[exp] = count
+
+    issuers = []
+    total_serials = 0
+    total_crls = 0
+    for iss in snap.issuers():
+        dates = by_issuer.get(iss, {})
+        crls = sorted(snap.crls.get(iss, ()))
+        dns = sorted(snap.dns.get(iss, ()))
+        n = sum(dates.values())
+        total_serials += n
+        total_crls += len(crls)
+        issuers.append({
+            "id": iss,
+            "dns": dns,
+            "crls": crls,
+            "serials": n,
+            "expDates": {exp: dates[exp] for exp in sorted(dates)},
+        })
+    database, _cache, _backend = get_configured_storage(config)
+    return {
+        "issuers": issuers,
+        "totals": {
+            "issuers": len(issuers),
+            "serials": total_serials,
+            "crls": total_crls,
+        },
+        "logStatus": _log_status_lines(config, database),
+    }
+
+
+def collect_database_report(config: CTConfig) -> dict:
+    """Machine-readable form of :func:`report_from_database` (cache
+    walk), same shape as :func:`collect_tpu_report`."""
+    database, _cache, _backend = get_configured_storage(config)
+    issuers = []
+    total_serials = 0
+    total_crls = 0
+    for issuer_obj in database.get_issuer_and_dates_from_cache():
+        meta = database.get_issuer_metadata(issuer_obj.issuer)
+        crls = sorted(meta.crls())
+        dns = sorted(meta.issuers())
+        exp_counts = {}
+        for exp_date in issuer_obj.exp_dates:
+            known = database.get_known_certificates(
+                exp_date, issuer_obj.issuer)
+            exp_counts[exp_date.id()] = known.count()
+        n = sum(exp_counts.values())
+        total_serials += n
+        total_crls += len(crls)
+        issuers.append({
+            "id": issuer_obj.issuer.id(),
+            "dns": dns,
+            "crls": crls,
+            "serials": n,
+            "expDates": {exp: exp_counts[exp] for exp in sorted(exp_counts)},
+        })
+    return {
+        "issuers": issuers,
+        "totals": {
+            "issuers": len(issuers),
+            "serials": total_serials,
+            "crls": total_crls,
+        },
+        "logStatus": _log_status_lines(config, database),
+    }
+
+
+def report_json(config: CTConfig, out) -> int:
+    """``--json``: the report as one machine-readable document."""
+    import json
+
+    if config.backend == "tpu":
+        report = collect_tpu_report(config)
+        if report is None:
+            print(
+                json.dumps({"error": "aggStatePath not found: "
+                            f"{config.agg_state_path!r}"}),
+                file=out,
+            )
+            return 1
+    else:
+        report = collect_database_report(config)
+    json.dump(report, out, indent=2)
+    print(file=out)
+    return 0
+
+
 def report_from_database(config: CTConfig, out, verbosity: int = 0) -> int:
     """Cache-walk path (reference parity)."""
     database, _cache, backend = get_configured_storage(config)
@@ -228,8 +347,15 @@ def report_from_database(config: CTConfig, out, verbosity: int = 0) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    # -json rides outside CTConfig (an output-format flag, not a
+    # directive); strip it before the config parser sees the rest.
+    json_mode = any(a in ("-json", "--json") for a in argv)
+    argv = [a for a in argv if a not in ("-json", "--json")]
     config = CTConfig.load(argv)
     prepare_telemetry("storage-statistics", config)
+    if json_mode:
+        return report_json(config, sys.stdout)
     if config.backend == "tpu":
         return report_from_tpu_snapshot(config, sys.stdout, config.verbosity)
     return report_from_database(config, sys.stdout, config.verbosity)

@@ -66,6 +66,8 @@ class CTConfig:
     # (telemetry/trace.py; CTMR_TRACE env equivalent; empty = off)
     metrics_port: int = 0  # Prometheus /metrics + /healthz HTTP port
     # (telemetry/promhttp.py; 0 = off)
+    query_port: int = 0  # batched membership-oracle JSON API port
+    # (serve/server.py; 0 = off; tpu backend only)
     verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
@@ -104,6 +106,7 @@ class CTConfig:
         "profileDir": ("profile_dir", str),
         "tracePath": ("trace_path", str),
         "metricsPort": ("metrics_port", int),
+        "queryPort": ("query_port", int),
     }
 
     @classmethod
@@ -258,6 +261,8 @@ class CTConfig:
             "spans here (CTMR_TRACE env equivalent)",
             "metricsPort = Serve Prometheus /metrics and /healthz on "
             "this port (0 disables)",
+            "queryPort = Serve the batched membership-oracle JSON API "
+            "(/query, /issuer, /getcert) on this port (0 disables)",
         ]
         return "\n".join(lines)
 

@@ -1,0 +1,318 @@
+"""The query plane's HTTP surface: a batched membership oracle as a
+stdlib JSON API (``queryPort`` directive).
+
+Endpoints:
+
+- ``POST /query`` — one or many membership questions. Body is either a
+  single query object or ``{"queries": [...]}``; each query is
+  ``{"issuer": <issuerID>, "expDate": <expDate id>, "serial": <hex>}``
+  (issuerID = base64url(SHA-256(SPKI)), expDate in the report formats
+  ``2031-06-15`` / ``2031-06-15-14``, serial as hex content bytes).
+  Optional ``"timeoutMs"`` is the request deadline. The response
+  carries per-query ``known`` flags plus the answering view's
+  ``epoch`` and ``staleness_s`` — a consumer always knows HOW current
+  the answer is. Overload is an explicit ``429 overloaded``; a missed
+  deadline is ``504 deadline_exceeded``.
+- ``GET /issuer/<issuerID>`` — per-issuer metadata (running unknown
+  total, CRL/DN set sizes) from the same pinned view.
+- ``GET /healthz`` — queue depth vs cap, snapshot age/epoch, shed
+  total: the numbers that distinguish "keeping up" from "shedding".
+- ``GET /getcert?log=<url>&index=<n>`` — serving-plane proxy for the
+  ``ct-getcert`` flow: the server (which already holds log
+  credentials/limits) fetches one entry and returns its PEM, so edge
+  clients need no direct log access.
+
+The oracle half (:class:`MembershipOracle`) is independent of HTTP —
+the bench serve leg and tests drive it in-process — and composes the
+two serving primitives: :class:`~ct_mapreduce_tpu.serve.snapshot.
+SnapshotManager` (epoch-pinned reads) and
+:class:`~ct_mapreduce_tpu.serve.batcher.MicroBatcher` (dynamic
+batching + admission control).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ct_mapreduce_tpu.core.types import ExpDate
+from ct_mapreduce_tpu.serve.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    Overloaded,
+)
+from ct_mapreduce_tpu.serve.snapshot import SnapshotManager
+from ct_mapreduce_tpu.telemetry.metrics import incr_counter
+
+
+class MembershipOracle:
+    """Batched "is serial S known for (issuer, expDate)?" over a live
+    aggregator, with snapshot isolation and dynamic batching."""
+
+    def __init__(
+        self,
+        agg,
+        max_batch: int = 4096,
+        max_delay_s: float = 0.002,
+        max_queue_lanes: int = 1 << 16,
+        max_staleness_s: float = 1.0,
+        device: bool = False,
+    ) -> None:
+        self._agg = agg
+        self.snapshots = SnapshotManager(
+            agg, max_staleness_s=max_staleness_s, device=device)
+        self.batcher = MicroBatcher(
+            self._run_batch, max_batch=max_batch, max_delay_s=max_delay_s,
+            max_queue_lanes=max_queue_lanes)
+
+    def _run_batch(self, items: list) -> list:
+        view = self.snapshots.view()
+        known = view.lookup(items)
+        age = view.age_s()
+        return [(bool(k), view.epoch, age) for k in known]
+
+    def query_raw(self, items: list,
+                  timeout_s: Optional[float] = None) -> list:
+        """items: [(issuer_idx, exp_hour, serial_bytes)] →
+        [(known, epoch, staleness_s)] (one pinned view per request)."""
+        return self.batcher.submit(items, timeout_s=timeout_s)
+
+    def resolve_issuer(self, issuer_id: str) -> int:
+        idx = self._agg.registry.index_of_issuer_id(issuer_id)
+        return -1 if idx is None else idx
+
+    def issuer_meta(self, issuer_id: str) -> Optional[dict]:
+        view = self.snapshots.view()
+        meta = view.issuer_meta(issuer_id)
+        if meta is not None:
+            meta["epoch"] = view.epoch
+            meta["staleness_s"] = round(view.age_s(), 6)
+        return meta
+
+    def stats(self) -> dict:
+        view = self.snapshots._view
+        return {
+            "queue_lanes": self.batcher.queue_lanes(),
+            "queue_cap": self.batcher.max_queue_lanes,
+            "max_batch": self.batcher.max_batch,
+            "max_delay_s": self.batcher.max_delay_s,
+            "snapshot_epoch": view.epoch if view else 0,
+            "snapshot_age_s": round(view.age_s(), 6) if view else None,
+        }
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+def _parse_query(q: dict, oracle: MembershipOracle):
+    """One JSON query object → (issuer_idx, exp_hour, serial_bytes).
+
+    Unknown issuers map to idx -1: the lookup treats them as
+    device-ineligible and the host-set probe can't match either, so
+    the answer is an honest ``known: false`` (the table has, by
+    definition, never counted a serial for an issuer the registry has
+    never seen)."""
+    issuer = q.get("issuer")
+    exp = q.get("expDate")
+    serial_hex = q.get("serial")
+    if not isinstance(issuer, str) or not isinstance(exp, str) \
+            or not isinstance(serial_hex, str):
+        raise ValueError("query needs string issuer, expDate, serial")
+    try:
+        serial = bytes.fromhex(serial_hex)
+    except ValueError as err:
+        raise ValueError(f"serial is not hex: {err}") from None
+    try:
+        eh = ExpDate.parse(exp).unix_hour()
+    except ValueError as err:
+        raise ValueError(f"bad expDate {exp!r}: {err}") from None
+    return (oracle.resolve_issuer(issuer), eh, serial)
+
+
+class QueryServer:
+    """Background HTTP server for the query plane (``queryPort``).
+
+    Mirrors :class:`~ct_mapreduce_tpu.telemetry.promhttp.MetricsServer`
+    mechanics: ``ThreadingHTTPServer`` on a daemon thread, port 0 binds
+    ephemeral (tests), ``stop()`` shuts down cleanly. ``transport``
+    overrides the CT-log HTTP transport for the ``/getcert`` proxy
+    (tests route it at an in-process fake log)."""
+
+    def __init__(self, agg, port: int, host: str = "0.0.0.0",
+                 max_batch: int = 4096, max_delay_s: float = 0.002,
+                 max_queue_lanes: int = 1 << 16,
+                 max_staleness_s: float = 1.0, device: bool = False,
+                 transport=None) -> None:
+        self.host = host
+        self.port = int(port)
+        self.oracle = MembershipOracle(
+            agg, max_batch=max_batch, max_delay_s=max_delay_s,
+            max_queue_lanes=max_queue_lanes,
+            max_staleness_s=max_staleness_s, device=device)
+        self._transport = transport
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request handling ------------------------------------------------
+    def handle_query(self, body: dict) -> tuple[int, dict]:
+        queries = body.get("queries")
+        single = queries is None
+        if single:
+            queries = [body]
+        if not isinstance(queries, list) or not queries:
+            return 400, {"error": "queries must be a non-empty list"}
+        try:
+            items = [_parse_query(q, self.oracle) for q in queries]
+        except (ValueError, AttributeError, TypeError) as err:
+            return 400, {"error": str(err)}
+        timeout_ms = body.get("timeoutMs")
+        timeout_s = float(timeout_ms) / 1e3 if timeout_ms else None
+        try:
+            results = self.oracle.query_raw(items, timeout_s=timeout_s)
+        except Overloaded as err:
+            return 429, {"error": "overloaded", "detail": str(err)}
+        except DeadlineExceeded as err:
+            return 504, {"error": "deadline_exceeded", "detail": str(err)}
+        # One request is never split across batches, so every result
+        # shares the request's single pinned view.
+        epoch = results[0][1]
+        staleness = results[0][2]
+        out = {
+            "results": [{"known": known} for known, _, _ in results],
+            "epoch": epoch,
+            "staleness_s": round(staleness, 6),
+        }
+        if single:
+            out["known"] = out["results"][0]["known"]
+        return 200, out
+
+    def handle_issuer(self, issuer_id: str) -> tuple[int, dict]:
+        meta = self.oracle.issuer_meta(issuer_id)
+        if meta is None:
+            return 404, {"error": "unknown issuer", "issuer": issuer_id}
+        return 200, meta
+
+    def handle_healthz(self) -> tuple[int, dict]:
+        from ct_mapreduce_tpu.telemetry.metrics import get_sink
+
+        counters = get_sink().snapshot().get("counters", {})
+        body = {
+            "healthy": True,
+            **self.oracle.stats(),
+            "shed_total": counters.get("serve.shed", 0.0),
+            "batches_total": counters.get("serve.batches", 0.0),
+        }
+        return 200, body
+
+    def handle_getcert(self, params: dict) -> tuple[int, dict]:
+        log_url = params.get("log")
+        index = params.get("index")
+        if not log_url or index is None:
+            return 400, {"error": "log and index are required"}
+        try:
+            index = int(index)
+        except ValueError:
+            return 400, {"error": f"index is not an integer: {index!r}"}
+        from ct_mapreduce_tpu.core.der import der_to_pem
+        from ct_mapreduce_tpu.ingest.ctclient import CTLogClient
+        from ct_mapreduce_tpu.ingest.leaf import (
+            LeafDecodeError,
+            decode_json_entry,
+        )
+
+        try:
+            client = CTLogClient(log_url, transport=self._transport)
+            entries = client.get_raw_entries(index, index)
+        except Exception as err:
+            return 502, {"error": f"log fetch failed: {err}"}
+        pems = []
+        for raw in entries:
+            try:
+                entry = decode_json_entry(
+                    raw.index,
+                    {"leaf_input": raw.leaf_input,
+                     "extra_data": raw.extra_data},
+                )
+            except LeafDecodeError as err:
+                return 502, {"error": f"undecodable entry: {err}"}
+            pem = der_to_pem(entry.cert_der)
+            pems.append(pem.decode() if isinstance(pem, bytes) else pem)
+        if not pems:
+            return 404, {"error": f"no entry at index {index}"}
+        return 200, {"log": log_url, "index": index, "pem": "".join(pems)}
+
+    # -- server lifecycle ------------------------------------------------
+    def start(self) -> "QueryServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _respond(self, code: int, body: dict) -> None:
+                payload = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                if code >= 400:
+                    incr_counter("serve", "http_errors")
+
+            def do_POST(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path != "/query":
+                    self._respond(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, json.JSONDecodeError) as err:
+                    self._respond(400, {"error": f"bad request: {err}"})
+                    return
+                try:
+                    self._respond(*server.handle_query(body))
+                except Exception as err:  # the server must answer
+                    self._respond(
+                        500, {"error": f"{type(err).__name__}: {err}"})
+
+            def do_GET(self):  # noqa: N802
+                raw_path, _, qs = self.path.partition("?")
+                path = raw_path.rstrip("/") or "/"
+                try:
+                    if path == "/healthz":
+                        self._respond(*server.handle_healthz())
+                    elif path.startswith("/issuer/"):
+                        from urllib.parse import unquote
+
+                        self._respond(*server.handle_issuer(
+                            unquote(path[len("/issuer/"):])))
+                    elif path == "/getcert":
+                        from urllib.parse import parse_qsl
+
+                        self._respond(
+                            *server.handle_getcert(dict(parse_qsl(qs))))
+                    else:
+                        self._respond(404, {"error": "not found"})
+                except Exception as err:
+                    self._respond(
+                        500, {"error": f"{type(err).__name__}: {err}"})
+
+            def log_message(self, *args):  # no per-request stderr spam
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="query-serve",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        self.oracle.close()

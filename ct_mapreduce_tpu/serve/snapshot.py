@@ -1,0 +1,317 @@
+"""Snapshot isolation for the query plane: epoch-pinned read views.
+
+Queries must not race ingest. The aggregator's table buffer is donated
+through every device step (the previous buffer is dead after dispatch)
+and its host-lane sets mutate under the fold lock, so a reader that
+touched live state mid-step could see a torn table or a half-folded
+batch. Instead of per-query locking, the query plane reads an
+**immutable epoch-pinned view**: :func:`capture_view` takes the
+aggregator's fold lock, then the table lock (the established global
+order — see ``TpuAggregator.__init__``), copies the table rows to host
+memory through the same one-fetch read the checkpoint writer uses, and
+freezes the host-lane serial sets. Every query against that view is
+lock-free and sees one consistent epoch.
+
+Consistency contract (pinned by the threaded stress test in
+tests/test_serve.py): any serial whose ingest was **acked** (its
+``complete()`` returned) before the view was captured reads as known —
+device-lane inserts land in the table at submit time (before the ack)
+and host-lane serials fold under the fold lock the capture holds — and
+a serial never fed cannot read known (membership is exact, not
+probabilistic: the 128-bit fingerprint's false-positive odds are the
+same ones the dedup itself already accepts).
+
+Staleness is a bound, not an accident: :class:`SnapshotManager`
+refreshes the view when it is older than ``max_staleness_s`` and every
+response carries the view's epoch and age, so a consumer can tell
+"known as of 0.3 s ago" from "known as of now".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ct_mapreduce_tpu.core import packing
+from ct_mapreduce_tpu.ops import buckettable, hashtable
+from ct_mapreduce_tpu.telemetry import trace
+from ct_mapreduce_tpu.telemetry.metrics import (
+    incr_counter,
+    measure,
+    set_gauge,
+)
+
+
+class TableView:
+    """One immutable epoch of aggregator state, query-ready.
+
+    ``rows`` is the host copy of the dedup table (fused layout rows for
+    either table layout; for a sharded aggregator the global
+    row-concatenated array, shard ``i`` owning the ``i``-th contiguous
+    block). ``host_serials`` maps ``(issuer_idx, exp_hour)`` to a
+    frozen set of exact-lane serial bytes. Membership is the union of
+    the two domains, mirroring the aggregator's own cross-domain
+    guards.
+    """
+
+    def __init__(
+        self,
+        epoch: int,
+        rows: np.ndarray,
+        layout: str,
+        n_shards: int,
+        max_probes: int,
+        base_hour: int,
+        host_serials: dict,
+        issuer_totals: np.ndarray,
+        crl_counts: dict,
+        dn_counts: dict,
+        registry,
+        table_fill: int,
+        capacity: int,
+        device: bool = False,
+        created_wall: Optional[float] = None,
+    ) -> None:
+        self.epoch = epoch
+        self.rows = rows
+        self.layout = layout
+        self.n_shards = n_shards
+        self.max_probes = max_probes
+        self.base_hour = base_hour
+        self.host_serials = host_serials
+        self.issuer_totals = issuer_totals
+        self.crl_counts = crl_counts
+        self.dn_counts = dn_counts
+        self.registry = registry
+        self.table_fill = table_fill
+        self.capacity = capacity
+        # Anchored at capture START (not completion): any ingest acked
+        # before this instant had released the fold lock before the
+        # capture acquired it, so it is provably inside the view — and
+        # the surfaced staleness errs larger, never smaller.
+        self.created_wall = (time.time() if created_wall is None
+                             else created_wall)
+        self._device = bool(device)
+        self._dev_rows = None  # lazily pinned device copy (device mode)
+
+    def age_s(self) -> float:
+        return max(0.0, time.time() - self.created_wall)
+
+    # -- membership ------------------------------------------------------
+    def contains_fps(self, fps: np.ndarray) -> np.ndarray:
+        """bool[n] membership of fingerprint rows ``uint32[n, 4]``
+        against the pinned table — host NumPy by default; ``device``
+        views pin one device copy and run the jitted ``contains``
+        kernels on pow2-padded batches (log-bounded compile shapes)."""
+        n = int(len(fps))
+        if n == 0 or self.rows.shape[0] == 0:
+            return np.zeros((n,), bool)
+        fps = np.asarray(fps, np.uint32).reshape(n, 4)
+        if self._device:
+            return self._contains_device(fps)
+        return self._contains_host(fps)
+
+    def _contains_host(self, fps: np.ndarray) -> np.ndarray:
+        if self.n_shards == 1:
+            if self.layout == "bucket":
+                return buckettable.contains_np(
+                    self.rows, fps, max_probes=self.max_probes)
+            return hashtable.contains_np(
+                self.rows, fps, max_probes=self.max_probes)
+        # Sharded read view: home shard from the routing hash, then the
+        # layout's local probe inside that shard's contiguous row block
+        # — the exact addressing the sharded insert used to place the
+        # key (one contains_np per occupied shard, not per lane).
+        from ct_mapreduce_tpu.agg.sharded import shard_of_np
+
+        dest = shard_of_np(fps, self.n_shards)
+        out = np.zeros((fps.shape[0],), bool)
+        block = self.rows.shape[0] // self.n_shards
+        for s in np.unique(dest):
+            sel = dest == s
+            local = self.rows[s * block : (s + 1) * block]
+            if self.layout == "bucket":
+                out[sel] = buckettable.contains_np(
+                    local, fps[sel], max_probes=self.max_probes)
+            else:
+                out[sel] = hashtable.contains_np(
+                    local, fps[sel], max_probes=self.max_probes)
+        return out
+
+    def _contains_device(self, fps: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        if self._dev_rows is None:
+            # Pinned once per view: queries must never touch the live
+            # (donated-through) table buffer.
+            self._dev_rows = jnp.asarray(self.rows)
+        n = fps.shape[0]
+        width = 1 << max(0, (n - 1).bit_length())
+        if width != n:
+            fps = np.pad(fps, ((0, width - n), (0, 0)))
+        keys = jnp.asarray(fps)
+        if self.n_shards > 1:
+            from ct_mapreduce_tpu.agg import sharded
+
+            fn = (sharded._contains_global_bucket
+                  if self.layout == "bucket" else sharded._contains_global)
+            found = fn(self._dev_rows, keys, n_shards=self.n_shards,
+                       max_probes=self.max_probes)
+        elif self.layout == "bucket":
+            found = buckettable.contains(
+                buckettable.BucketTable(self._dev_rows,
+                                        jnp.zeros((), jnp.int32)),
+                keys, max_probes=self.max_probes)
+        else:
+            found = hashtable.contains(
+                hashtable.TableState(self._dev_rows,
+                                     jnp.zeros((), jnp.int32)),
+                keys, max_probes=self.max_probes)
+        return np.asarray(found)[:n]
+
+    def lookup(self, items: list) -> np.ndarray:
+        """Batch membership: ``items`` is a list of
+        ``(issuer_idx, exp_hour, serial_bytes)`` (``issuer_idx`` may be
+        ``-1`` for an issuer the registry has never seen). Returns
+        bool[n]: known in EITHER dedup domain.
+
+        Device-eligible lanes (serial fits the fingerprint window,
+        issuer/hour in meta range — the same predicates that routed
+        them to the device at ingest) probe the pinned table through
+        the vectorized host fingerprint; every lane additionally checks
+        the frozen host-lane set, because overflow/boundary routing
+        means the domains can overlap (aggregator module docstring).
+        """
+        n = len(items)
+        out = np.zeros((n,), bool)
+        if n == 0:
+            return out
+        idx = np.fromiter((it[0] for it in items), np.int64, n)
+        eh = np.fromiter((it[1] for it in items), np.int64, n)
+        slen = np.fromiter((len(it[2]) for it in items), np.int64, n)
+        eligible = (
+            (idx >= 0)
+            & (idx < packing.MAX_ISSUERS)
+            & (slen <= packing.MAX_SERIAL_BYTES)
+            & (eh - self.base_hour >= 0)
+            & (eh - self.base_hour < packing.META_HOUR_SPAN)
+        )
+        sel = np.nonzero(eligible)[0]
+        if sel.size:
+            serials = np.zeros((sel.size, packing.MAX_SERIAL_BYTES), np.uint8)
+            for j, p in enumerate(sel):
+                sb = items[p][2]
+                serials[j, : len(sb)] = np.frombuffer(sb, np.uint8)
+            fps = packing.fingerprints_np(
+                idx[sel], eh[sel], serials, slen[sel])
+            out[sel] = self.contains_fps(fps)
+        if self.host_serials:
+            for p in range(n):
+                if not out[p]:
+                    bucket = self.host_serials.get((int(idx[p]), int(eh[p])))
+                    if bucket is not None and items[p][2] in bucket:
+                        out[p] = True
+        return out
+
+    # -- metadata --------------------------------------------------------
+    def issuer_meta(self, issuer_id: str) -> Optional[dict]:
+        """Per-issuer metadata as of this epoch, or None when the
+        registry has never seen the issuer."""
+        idx = self.registry.index_of_issuer_id(issuer_id)
+        if idx is None:
+            return None
+        total = (int(self.issuer_totals[idx])
+                 if idx < self.issuer_totals.shape[0] else 0)
+        return {
+            "issuer": issuer_id,
+            "unknown_total": total,
+            "crls": int(self.crl_counts.get(idx, 0)),
+            "dns": int(self.dn_counts.get(idx, 0)),
+        }
+
+
+def capture_view(agg, epoch: int, device: bool = False) -> TableView:
+    """Pin one epoch of ``agg`` (TpuAggregator, ShardedAggregator, or
+    the host snapshot reader) into an immutable :class:`TableView`.
+
+    Lock order is fold → table, matching every other cross-state reader
+    (``grow``, ``drain``): holding the fold lock freezes the host-lane
+    sets mid-nothing (folds serialize on it), and the table lock
+    guarantees the row fetch reads a live, fully-stepped buffer. The
+    row read is the checkpoint writer's one-fetch idiom
+    (``_write_npz``): a single D2H of ``table.rows`` rather than
+    per-field property reads."""
+    t0 = time.time()
+    with agg._fold_lock:
+        with agg._table_lock:
+            dedup = getattr(agg, "dedup", None)
+            if dedup is not None:  # mesh-sharded: global row view
+                rows = np.asarray(dedup.rows)
+                layout = dedup.layout
+                n_shards = dedup.n_shards
+            else:
+                layout = ("bucket"
+                          if isinstance(agg.table, buckettable.BucketTable)
+                          else "open")
+                rows = np.asarray(agg.table.rows)
+                n_shards = 1
+        host_serials = {k: frozenset(v)
+                        for k, v in agg.host_serials.items() if v}
+        issuer_totals = agg.issuer_totals.copy()
+        crl_counts = {i: len(s) for i, s in agg.crl_sets.items()}
+        dn_counts = {i: len(s) for i, s in agg.dn_sets.items()}
+        table_fill = agg._table_fill
+    return TableView(
+        epoch=epoch, rows=rows, layout=layout, n_shards=n_shards,
+        max_probes=agg.max_probes, base_hour=agg.base_hour,
+        host_serials=host_serials, issuer_totals=issuer_totals,
+        crl_counts=crl_counts, dn_counts=dn_counts, registry=agg.registry,
+        table_fill=table_fill,
+        capacity=getattr(agg, "capacity", rows.shape[0]),
+        device=device,
+        created_wall=t0,
+    )
+
+
+class SnapshotManager:
+    """Bounded-staleness view cache: ``view()`` returns the current
+    epoch, refreshing (at most one capture in flight — concurrent
+    requesters coalesce on the losing side of the lock) once the view
+    is older than ``max_staleness_s``. ``refresh()`` forces a new
+    epoch, e.g. after a checkpoint restore."""
+
+    def __init__(self, agg, max_staleness_s: float = 1.0,
+                 device: bool = False) -> None:
+        self._agg = agg
+        self.max_staleness_s = float(max_staleness_s)
+        self._device = bool(device)
+        self._lock = threading.Lock()
+        self._view: Optional[TableView] = None
+        self._epoch = 0
+
+    def view(self) -> TableView:
+        v = self._view
+        if v is not None and v.age_s() <= self.max_staleness_s:
+            return v
+        with self._lock:
+            v = self._view  # a concurrent refresher may have won
+            if v is not None and v.age_s() <= self.max_staleness_s:
+                return v
+            return self._refresh_locked()
+
+    def refresh(self) -> TableView:
+        with self._lock:
+            return self._refresh_locked()
+
+    def _refresh_locked(self) -> TableView:
+        self._epoch += 1
+        with trace.span("serve.snapshot", cat="serve", epoch=self._epoch), \
+                measure("serve", "snapshot_capture_s"):
+            v = capture_view(self._agg, self._epoch, device=self._device)
+        self._view = v
+        incr_counter("serve", "snapshot_refresh")
+        set_gauge("serve", "snapshot_epoch", value=float(self._epoch))
+        return v

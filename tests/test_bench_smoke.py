@@ -51,6 +51,20 @@ def test_bench_smoke_overlap_gate(monkeypatch):
                             "ingest.drain"}
     assert all(s["busy_s"] > 0 for s in summary.values())
     assert wall > 0
+    # Serve leg (ISSUE 5): run_smoke itself gates parity-under-ingest,
+    # the span-derived p99 wait budget, and the shed behavior; here we
+    # pin that the leg RAN and its numbers are sane — dynamic batching
+    # really formed batches (mean lanes/batch > 1, some batch merged
+    # several requests), occupancy came from serve.batch spans (a
+    # tracer regression zeroes the batch count and fails here), and
+    # overload shed explicitly.
+    assert out["smoke_serve_parity"] == 1
+    assert out["smoke_serve_batches"] > 0
+    assert out["smoke_serve_mean_batch_lanes"] > 1.0
+    assert out["smoke_serve_max_batch_requests"] > 1
+    assert out["smoke_serve_lanes_per_s"] > 0
+    assert 0 < out["smoke_serve_wait_p50_ms"] <= out["smoke_serve_wait_p99_ms"]
+    assert out["smoke_serve_shed"] > 0
     # Pre-parsed leg: run_smoke itself asserts exact parity with the
     # walker lanes AND that D2H flag traffic stays O(flagged); here we
     # only pin that the leg ran when the native extractor exists (its

@@ -311,6 +311,79 @@ def test_storage_statistics_parity_mode(tmp_path, monkeypatch, capsys):
     assert "Serials:" in text
 
 
+def test_storage_statistics_json_parity(tmp_path, monkeypatch, capsys):
+    """--json emits the same numbers the text report prints (ISSUE 5
+    satellite): totals line vs totals object, per-expDate -v1 counts
+    vs the expDates maps, and the Log status walk."""
+    import json
+    import re
+
+    log = _fake_log(n=6, dupes=2)
+    _patch_transport(monkeypatch, log)
+    ini = tmp_path / "ct.ini"
+    state = tmp_path / "agg.npz"
+    ini.write_text(
+        f"logList = {log.url}\n"
+        "backend = tpu\n"
+        "batchSize = 64\n"
+        "tableBits = 12\n"
+        f"aggStatePath = {state}\n"
+        "healthAddr = \n"
+    )
+    assert ct_fetch.main(["-config", str(ini), "-nobars"]) == 0
+
+    rc = storage_statistics.main(["-config", str(ini), "-v", "1"])
+    assert rc == 0
+    text = capsys.readouterr().out
+
+    rc = storage_statistics.main(["-config", str(ini), "-json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+
+    m = re.search(r"overall totals: (\d+) issuers, (\d+) serials, "
+                  r"(\d+) crls", text)
+    assert m
+    assert report["totals"] == {
+        "issuers": int(m.group(1)),
+        "serials": int(m.group(2)),
+        "crls": int(m.group(3)),
+    }
+    # Per-expDate counts match the -v1 bullet lines number for number.
+    text_counts = dict(re.findall(r"- (\S+) \((\d+) serials\)", text))
+    json_counts = {
+        exp: str(n)
+        for iss in report["issuers"]
+        for exp, n in iss["expDates"].items()
+    }
+    assert json_counts == text_counts
+    for iss in report["issuers"]:
+        assert iss["serials"] == sum(iss["expDates"].values())
+        assert f"Issuer: {iss['id']}" in text
+    # Log status rides along as data.
+    status_lines = text.split("Log status:")[1].strip().splitlines()
+    assert report["logStatus"] == [ln for ln in status_lines if ln]
+
+    # Database mode --json: same collector shape over the cache walk.
+    from ct_mapreduce_tpu.engine import get_configured_storage
+    from ct_mapreduce_tpu.ingest.sync import DatabaseSink, LogSyncEngine
+
+    cfg = CTConfig.load([])
+    database, cache, backend = get_configured_storage(cfg)
+    sink = DatabaseSink(database,
+                       now=datetime.datetime(2025, 1, 1, tzinfo=UTC))
+    engine = LogSyncEngine(sink, database, num_threads=1)
+    engine.start_store_threads()
+    engine.sync_log(log.url, transport=log.transport)
+    engine.wait_for_downloads(timeout=30)
+    engine.stop()
+    with mock.patch(
+        "ct_mapreduce_tpu.cmd.storage_statistics.get_configured_storage",
+        return_value=(database, cache, backend),
+    ):
+        db_report = storage_statistics.collect_database_report(cfg)
+    assert db_report["totals"] == report["totals"]
+
+
 def test_ct_getcert(capsys):
     log = _fake_log(n=3)
     out = io.StringIO()
@@ -329,3 +402,165 @@ def test_ct_getcert(capsys):
 
     fields = hostder.parse_cert(der)
     assert fields.serial == (1001).to_bytes(2, "big")
+
+
+def test_ct_getcert_routes_via_query_plane(tmp_path):
+    """queryPort satellite: with a query plane up, ct-getcert fetches
+    through its /getcert proxy (no direct log transport at all); with
+    the plane down, it falls back to the direct transport."""
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.serve.server import QueryServer
+
+    log = _fake_log(n=3)
+    agg = TpuAggregator(capacity=1 << 10, batch_size=64)
+    srv = QueryServer(agg, 0, host="127.0.0.1",
+                      transport=log.transport).start()
+    try:
+        out = io.StringIO()
+        # transport=None: a direct log fetch would hit the network and
+        # fail — success proves the plane served the PEM.
+        rc = ct_getcert.main(
+            ["-log", log.url, "-index", "1",
+             "-queryAddr", f"127.0.0.1:{srv.port}"],
+            transport=None, out=out,
+        )
+        assert rc == 0
+        assert out.getvalue().startswith("-----BEGIN CERTIFICATE-----")
+
+        # The config path resolves queryPort the same way.
+        ini = tmp_path / "q.ini"
+        ini.write_text(f"queryPort = {srv.port}\n")
+        out = io.StringIO()
+        rc = ct_getcert.main(
+            ["-log", log.url, "-index", "0", "-config", str(ini)],
+            transport=None, out=out,
+        )
+        assert rc == 0
+        assert out.getvalue().startswith("-----BEGIN CERTIFICATE-----")
+    finally:
+        srv.stop()
+
+    # Plane gone: the same invocation falls back to the given direct
+    # transport and still succeeds.
+    out = io.StringIO()
+    rc = ct_getcert.main(
+        ["-log", log.url, "-index", "1",
+         "-queryAddr", f"127.0.0.1:{srv.port}"],
+        transport=log.transport, out=out,
+    )
+    assert rc == 0
+    assert out.getvalue().startswith("-----BEGIN CERTIFICATE-----")
+
+
+def test_ct_query_cli(capsys):
+    """ct-query end to end: known serial exits 0, unknown exits 1,
+    issuer metadata and health print JSON, unreachable plane exits 2."""
+    import json
+
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.cmd import ct_query
+    from ct_mapreduce_tpu.core import der as hostder
+    from ct_mapreduce_tpu.core.types import ExpDate, Issuer
+    from ct_mapreduce_tpu.serve.server import QueryServer
+    from ct_mapreduce_tpu.utils import syncerts
+
+    tpl = syncerts.make_template(issuer_cn="Query CLI CA")
+    agg = TpuAggregator(capacity=1 << 12, batch_size=64)
+    agg.ingest([(syncerts.stamp_serial(tpl, j), tpl.issuer_der)
+                for j in range(5)])
+    issuer_id = Issuer.from_spki(
+        hostder.parse_cert(tpl.issuer_der).spki).id()
+    eh = hostder.parse_cert(tpl.leaf_der).not_after_unix_hour
+    exp_id = ExpDate.from_unix_hour(eh).id()
+
+    def serial_hex(j):
+        der = syncerts.stamp_serial(tpl, j)
+        return der[tpl.serial_off:tpl.serial_off + tpl.serial_len].hex()
+
+    srv = QueryServer(agg, 0, host="127.0.0.1", max_delay_s=0.001).start()
+    try:
+        addr = f"127.0.0.1:{srv.port}"
+        out = io.StringIO()
+        rc = ct_query.main(
+            ["-addr", addr, "-issuer", issuer_id, "-expDate", exp_id,
+             "-serial", serial_hex(0), "-serial", serial_hex(4)],
+            out=out,
+        )
+        assert rc == 0
+        resp = json.loads(out.getvalue())
+        assert [r["known"] for r in resp["results"]] == [True, True]
+        assert resp["epoch"] >= 1 and "staleness_s" in resp
+
+        out = io.StringIO()
+        rc = ct_query.main(
+            ["-addr", addr, "-issuer", issuer_id, "-expDate", exp_id,
+             "-serial", serial_hex(999)],
+            out=out,
+        )
+        assert rc == 1  # unknown serial, grep-style exit
+
+        out = io.StringIO()
+        rc = ct_query.main(["-addr", addr, "-issuerMeta", issuer_id],
+                           out=out)
+        assert rc == 0
+        assert json.loads(out.getvalue())["unknown_total"] == 5
+
+        out = io.StringIO()
+        rc = ct_query.main(["-addr", addr, "-health"], out=out)
+        assert rc == 0
+        assert json.loads(out.getvalue())["healthy"] is True
+    finally:
+        srv.stop()
+    # Plane gone: transport error exits 2.
+    rc = ct_query.main(
+        ["-addr", f"127.0.0.1:{srv.port}", "-health"], out=io.StringIO())
+    assert rc == 2
+
+
+def test_ct_fetch_starts_query_plane(tmp_path, monkeypatch):
+    """queryPort on ct-fetch: the query plane answers membership for
+    the serials the run just ingested — asserted from inside the run
+    via the engine's store path (the server outlives sync_log but not
+    main), so we probe after main() via a spy that captured the port.
+
+    The plane binds an ephemeral port (queryPort directive value 0 is
+    'off', so the test patches QueryServer to record the bound port
+    and uses a fixed free one)."""
+    import socket
+
+    from ct_mapreduce_tpu.serve import server as serve_server
+
+    log = _fake_log(n=5, dupes=1)
+    _patch_transport(monkeypatch, log)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    probed = {}
+    orig_stop = serve_server.QueryServer.stop
+
+    def spy_stop(self):
+        # Probe while the plane is still serving (just before ct-fetch
+        # tears it down): the live aggregator answers.
+        try:
+            from ct_mapreduce_tpu.serve.client import QueryClient
+
+            probed["health"] = QueryClient(
+                f"127.0.0.1:{self.port}").healthz()
+        finally:
+            orig_stop(self)
+
+    monkeypatch.setattr(serve_server.QueryServer, "stop", spy_stop)
+    ini = tmp_path / "ct.ini"
+    ini.write_text(
+        f"logList = {log.url}\n"
+        "backend = tpu\n"
+        "batchSize = 64\n"
+        "tableBits = 12\n"
+        f"aggStatePath = {tmp_path / 'agg.npz'}\n"
+        f"queryPort = {port}\n"
+        "healthAddr = \n"
+    )
+    rc = ct_fetch.main(["-config", str(ini), "-nobars"])
+    assert rc == 0
+    assert probed["health"]["healthy"] is True

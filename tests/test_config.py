@@ -135,3 +135,19 @@ def test_observability_directives(tmp_path):
     assert off.trace_path == "" and off.metrics_port == 0
     usage = CTConfig().usage()
     assert "tracePath" in usage and "metricsPort" in usage
+
+
+def test_query_port_directive(tmp_path):
+    """queryPort (ISSUE 5): ini + env layering, int parse, usage()."""
+    ini = tmp_path / "ct.ini"
+    ini.write_text("queryPort = 9090\n")
+    cfg = CTConfig.load(argv=["--config", str(ini)], env={})
+    assert cfg.query_port == 9090
+    cfg2 = CTConfig.load(argv=["--config", str(ini)],
+                         env={"queryPort": "9999"})
+    assert cfg2.query_port == 9999
+    cfg3 = CTConfig.load(argv=["--config", str(ini)],
+                         env={"queryPort": "banana"})
+    assert cfg3.query_port == 9090
+    assert CTConfig.load(argv=[], env={}).query_port == 0  # default off
+    assert "queryPort" in CTConfig().usage()

@@ -1,0 +1,166 @@
+"""qps_sweep: the query plane's batch-size × max-delay frontier.
+
+Maps the dynamic-batching tradeoff of serve/batcher.py the way an
+inference-serving team tunes a model server: for each
+(max_batch, max_delay) point, closed-loop client threads hammer a
+MembershipOracle over a pre-filled dedup table and we record achieved
+QPS, client p50/p99 latency, mean lanes per executed batch, and the
+shed rate. Small max_delay buys latency at the cost of batch
+amortization; large max_batch only pays off once concurrency can fill
+it — the frontier says which knee to run at.
+
+Usage:
+    python tools/qps_sweep.py [--entries 200000] [--threads 8]
+        [--duration 0.5] [--batches 16,64,256,1024]
+        [--delays-ms 0.5,2,5] [--json]
+
+CPU-friendly (JAX_PLATFORMS=cpu works); on a TPU host the same sweep
+measures the device `contains` path via --device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_aggregator(entries: int, table_bits: int):
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.core import packing
+
+    agg = TpuAggregator(capacity=1 << table_bits, batch_size=4096,
+                        grow_at=0.0)
+    eh = agg.base_hour + 1000
+    serials = np.zeros((entries, packing.MAX_SERIAL_BYTES), np.uint8)
+    counters = np.arange(entries, dtype=np.uint64)
+    for i in range(8):
+        serials[:, 15 - i] = ((counters >> np.uint64(8 * i))
+                              & np.uint64(0xFF)).astype(np.uint8)
+    slen = np.full((entries,), 16, np.int64)
+    keys = packing.fingerprints_np(
+        np.zeros((entries,), np.int64), np.full((entries,), eh, np.int64),
+        serials, slen)
+    meta = np.full((entries,), packing.pack_meta(0, eh, agg.base_hour),
+                   np.uint32)
+    ovf = agg._bulk_reinsert(keys, meta)
+    if ovf:
+        raise SystemExit(f"table too small: {ovf} overflow rows; "
+                         "raise --table-bits")
+    agg._table_fill = entries
+    agg._device_written = True
+    return agg, eh
+
+
+def serial_bytes(j: int) -> bytes:
+    return b"\x00" * 8 + int(j).to_bytes(8, "big")
+
+
+def run_point(agg, eh: int, entries: int, max_batch: int,
+              max_delay_s: float, threads: int, duration_s: float,
+              device: bool) -> dict:
+    from ct_mapreduce_tpu.serve.batcher import Overloaded
+    from ct_mapreduce_tpu.serve.server import MembershipOracle
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
+    sink = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink)
+    oracle = MembershipOracle(
+        agg, max_batch=max_batch, max_delay_s=max_delay_s,
+        max_queue_lanes=max(4 * max_batch, 1024),
+        max_staleness_s=60.0, device=device)
+    oracle.snapshots.refresh()  # capture outside the timed window
+    lat: list[float] = []
+    shed = [0]
+    stop = time.perf_counter() + duration_s
+
+    def client(seed: int) -> None:
+        rng = np.random.default_rng(seed)
+        while time.perf_counter() < stop:
+            j = int(rng.integers(2 * entries))  # half present, half not
+            t0 = time.perf_counter()
+            try:
+                res = oracle.query_raw([(0, eh, serial_bytes(j))])
+            except Overloaded:
+                shed.append(1)
+                continue
+            lat.append(time.perf_counter() - t0)
+            assert res[0][0] == (j < entries), f"parity broke at {j}"
+
+    ts = [threading.Thread(target=client, args=(s,)) for s in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    oracle.close()
+    tmetrics.set_sink(prev)
+    snap = sink.snapshot()
+    lanes = snap["counters"].get("serve.lanes", 0.0)
+    batches = snap["counters"].get("serve.batches", 0.0)
+    lat.sort()
+    n = len(lat)
+    return {
+        "max_batch": max_batch,
+        "max_delay_ms": round(max_delay_s * 1e3, 3),
+        "qps": round(n / wall, 1),
+        "p50_ms": round(lat[n // 2] * 1e3, 3) if n else None,
+        "p99_ms": (round(lat[min(n - 1, int(0.99 * n))] * 1e3, 3)
+                   if n else None),
+        "mean_batch_lanes": round(lanes / batches, 2) if batches else 0.0,
+        "shed": len(shed) - 1,
+        "queries": n,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--entries", type=int, default=200_000)
+    ap.add_argument("--table-bits", type=int, default=20)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=0.5,
+                    help="seconds per sweep point")
+    ap.add_argument("--batches", default="16,64,256,1024")
+    ap.add_argument("--delays-ms", default="0.5,2,5")
+    ap.add_argument("--device", action="store_true",
+                    help="serve from a pinned device copy (jitted "
+                    "contains) instead of the host mirror")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    agg, eh = build_aggregator(args.entries, args.table_bits)
+    print(f"# table: {args.entries} entries in 2^{args.table_bits} slots, "
+          f"{args.threads} closed-loop threads, "
+          f"{args.duration}s/point, "
+          f"{'device' if args.device else 'host'} contains",
+          file=sys.stderr)
+    rows = []
+    for mb in (int(x) for x in args.batches.split(",")):
+        for dly in (float(x) for x in args.delays_ms.split(",")):
+            r = run_point(agg, eh, args.entries, mb, dly / 1e3,
+                          args.threads, args.duration, args.device)
+            rows.append(r)
+            print(f"# {r}", file=sys.stderr)
+    if args.json:
+        json.dump(rows, sys.stdout, indent=2)
+        print()
+    else:
+        hdr = ("max_batch", "max_delay_ms", "qps", "p50_ms", "p99_ms",
+               "mean_batch_lanes", "shed")
+        print("\t".join(hdr))
+        for r in rows:
+            print("\t".join(str(r[h]) for h in hdr))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
