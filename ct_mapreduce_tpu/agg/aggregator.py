@@ -259,6 +259,98 @@ class PendingPreparsed:
             return self._res
 
 
+class _NpStagedChunkOut:
+    """One chunk's slice of a staged envelope readback, shaped like a
+    host-resident :class:`~ct_mapreduce_tpu.ops.pipeline.StepOut` so
+    ``_consume_out``'s NumPy branch folds it through the exact same
+    code path as the serial step (parity by construction). ``packed``
+    is one ``int32[7, B]`` row of the envelope's ``[K, 7, B]`` packed
+    readback — the bit layout of ``_pack_out``, assembled on device by
+    ``pipeline.pack_lane_words``."""
+
+    def __init__(self, packed_row: np.ndarray, serials: np.ndarray,
+                 issuer_unknown_counts: np.ndarray) -> None:
+        flags = packed_row[0]
+        self.host_lane = (flags & 1) != 0
+        self.was_unknown = ((flags >> 1) & 1) != 0
+        self.filtered_ca = ((flags >> 2) & 1) != 0
+        self.filtered_expired = ((flags >> 3) & 1) != 0
+        self.filtered_cn = ((flags >> 4) & 1) != 0
+        self.probe_overflow = ((flags >> 5) & 1) != 0
+        self.not_after_hour = packed_row[1]
+        self.serial_len = packed_row[2]
+        self.crldp_off = packed_row[3]
+        self.crldp_len = packed_row[4]
+        self.issuer_name_off = packed_row[5]
+        self.issuer_name_len = packed_row[6]
+        self.serials = serials
+        self.issuer_unknown_counts = issuer_unknown_counts
+
+
+class PendingStaged:
+    """Async half of :meth:`TpuAggregator.ingest_staged_submit` — one
+    K-chunk walker envelope. Same FIFO / claim-before-fold / fold-lock
+    contract as :class:`PendingIngest`, but the readback is the
+    envelope's ONE packed ``[K, 7, B]`` array (+ the summed issuer
+    counts, + the serial matrix only when the sink keeps PEMs) instead
+    of a packing jit + readback per chunk — and the fold then walks the
+    K chunks through the very same ``_consume_out``/``_host_lanes``
+    code the serial path uses."""
+
+    def __init__(self, agg: "TpuAggregator", out, chunks,
+                 res: IngestResult, chunk_width: int) -> None:
+        self._agg = agg
+        self._out = out  # pipeline.StagedStepOut
+        self._chunks = chunks  # [(batch, device_pos, lane_of)]
+        self._res = res
+        self._chunk_width = int(chunk_width)  # pos = k * width + lane
+        self._done = False
+        self._lock = threading.Lock()
+
+    def complete(self) -> IngestResult:
+        with self._lock:
+            if self._done:
+                return self._res
+            self._done = True
+            agg = self._agg
+            with trace.span("device.fold", cat="device"), agg._fold_lock:
+                with contextlib.suppress(ValueError):
+                    agg._outstanding.remove(self)
+                agg._inflight_lanes = max(
+                    0, agg._inflight_lanes - len(self._res.was_unknown))
+                res = self._res
+                P = np.asarray(self._out.packed)  # the one packed read
+                counts = np.asarray(self._out.issuer_unknown_counts)
+                serials = (np.asarray(self._out.serials)
+                           if agg.want_serials else None)
+                nothing = np.zeros((0,), np.int32)
+                host_lane_total = 0
+                for k, (batch, device_pos, lane_of) in enumerate(
+                        self._chunks):
+                    out_k = _NpStagedChunkOut(
+                        P[k],
+                        serials[k] if serials is not None else P[k, 2:3],
+                        # Counts are device-summed across the envelope;
+                        # attribute them to the first chunk's fold (the
+                        # running totals are order-insensitive sums).
+                        counts if k == 0 else nothing,
+                    )
+                    host_pos = agg._consume_out(
+                        batch, out_k, device_pos, res, lane_of)
+                    host_lane_total += agg._host_lanes(
+                        host_pos,
+                        lambda pos, _b=batch, _k=k: _b.data[
+                            pos - _k * self._chunk_width,
+                            : _b.length[pos - _k * self._chunk_width],
+                        ].tobytes(),
+                        res,
+                    )
+                agg.metrics["host_lane"] += host_lane_total
+                res.host_lane_count = host_lane_total
+                incr_counter("aggregator", "batches")
+            return self._res
+
+
 @dataclass
 class AggregateSnapshot:
     """Drained reduce state — the material of storage-statistics."""
@@ -468,19 +560,34 @@ class TpuAggregator:
 
         Dispatch AND materialization run under the table lock: the
         donated step invalidates the previous table buffer, so a probe
-        racing a concurrent submit could read a deleted array."""
+        racing a concurrent submit could read a deleted array.
+
+        Probe batches are padded to the next power of two (min 16) so
+        the jitted contains kernel compiles once per log bucket, not
+        once per ragged host-lane count — the same log-bounded
+        compile-shape rule the sharded dispatch uses (padding lanes'
+        results are sliced off; a spurious hit on a zero key costs
+        nothing because the lane is discarded)."""
         import jax.numpy as jnp
 
+        n = int(fps.shape[0])
+        if n == 0:
+            return np.zeros((0,), bool)
+        width = max(16, 1 << (n - 1).bit_length())
+        if width != n:
+            fps = np.pad(np.asarray(fps), ((0, width - n), (0, 0)))
         with self._table_lock:
             if isinstance(self.table, buckettable.BucketTable):
-                return np.asarray(
+                out = np.asarray(
                     buckettable.contains(self.table, jnp.asarray(fps),
                                          max_probes=self.max_probes),
                 )
-            return np.asarray(
-                hashtable.contains(self.table, jnp.asarray(fps),
-                                   max_probes=self.max_probes),
-            )
+            else:
+                out = np.asarray(
+                    hashtable.contains(self.table, jnp.asarray(fps),
+                                       max_probes=self.max_probes),
+                )
+        return out[:n]
 
     # -- load-factor policy ---------------------------------------------
     def _table_fill_exact(self) -> int:
@@ -764,6 +871,90 @@ class TpuAggregator:
             except IndexError:
                 return
             pending.complete()
+
+    # -- staged device queue (K-chunk walker envelope) -------------------
+    # True when the staged lane wants its row buffers shipped to the
+    # device ahead of the dispatch (the sink's staging ring device_puts
+    # the stacked [K, B, L] buffer at submit time so the transfer
+    # overlaps the previous envelope's compute). The mesh-sharded
+    # subclass routes rows host-side and overrides this to False.
+    staged_h2d = True
+
+    def ingest_staged_submit(
+        self,
+        data,  # uint8[K, B, L] — device array (H2D enqueued) or np
+        length: np.ndarray,  # int32[K, B]
+        issuer_idx: np.ndarray,  # int32[K, B]
+        valid: np.ndarray,  # bool[K, B]
+        host_chunks: list[np.ndarray],  # per REAL chunk: uint8[n_k, L]
+    ) -> "PendingStaged":
+        """Dispatch ONE resident K-chunk walker envelope
+        (:func:`ct_mapreduce_tpu.ops.pipeline.staged_core`) without
+        reading anything back. Chunk ``k``'s lanes land at result
+        positions ``k * B + lane``; chunks past ``len(host_chunks)``
+        are all-invalid padding (the staging ring flushed early).
+        ``host_chunks`` keeps the caller's own host-resident rows alive
+        for host-lane slices and PEM folds — the device buffer may be
+        donated and the staging buffer recycled, so neither is read
+        after this call."""
+        k_chunks, b = length.shape
+        n = k_chunks * b
+        valid = np.asarray(valid, bool)
+        length = np.asarray(length, np.int32)
+        issuer_idx = np.asarray(issuer_idx, np.int32)
+        # Growth estimate counts the REAL chunks' lanes, not the
+        # all-invalid K-axis padding of a partial ring — a tail flush
+        # claiming K×B incoming lanes grew tables 4× early.
+        self.maybe_grow(incoming=sum(
+            int(c.shape[0]) for c in host_chunks))
+        self._inflight_lanes += n
+        res = IngestResult(
+            was_unknown=np.zeros((n,), bool),
+            filtered=np.zeros((n,), bool),
+            exp_hours=np.zeros((n,), np.int32),
+            serials=[None] * n,
+            issuer_idx=issuer_idx.reshape(n).copy(),
+        )
+        chunks = []
+        for k, rows in enumerate(host_chunks):
+            n_k = int(rows.shape[0])
+            batch = packing.PackedBatch(
+                rows, length[k, :n_k], issuer_idx[k, :n_k], valid[k, :n_k]
+            )
+            lanes = np.nonzero(valid[k])[0]
+            device_pos = [k * b + int(j) for j in lanes]
+            if len(device_pos) == b:
+                lane_of = None  # contiguous full chunk: lane == index
+            else:
+                lane_of = lambda pos, _k=k, _b=b: pos - _k * _b  # noqa: E731
+            chunks.append((batch, device_pos, lane_of))
+        out = self._device_step_staged(data, length, issuer_idx, valid)
+        pending = PendingStaged(self, out, chunks, res, chunk_width=b)
+        self._outstanding.append(pending)
+        return pending
+
+    def _device_step_staged(self, data, length, issuer_idx, valid):
+        self._device_written = True
+        import jax
+
+        # Donation picks by residency and backend exactly like the
+        # walker pair: device-resident rows (the staging ring enqueued
+        # their H2D) donate through the envelope so XLA recycles the
+        # buffer HBM; NumPy rows and the CPU backend (whose XLA can't
+        # alias these layouts and warns per dispatch) stay undonated.
+        step = (pipeline.ingest_step_staged_donated
+                if isinstance(data, jax.Array)
+                and jax.default_backend() != "cpu"
+                else pipeline.ingest_step_staged)
+        with trace.span("device.step_staged", cat="device",
+                        chunks=int(length.shape[0])), self._table_lock:
+            self.table, out = step(
+                self.table, data, length, issuer_idx, valid,
+                np.int32(self._now_hour()), np.int32(self.base_hour),
+                self._prefix_arr, self._prefix_lens,
+                max_probes=self.max_probes,
+            )
+        return out
 
     # -- pre-parsed ingest lane ------------------------------------------
     def ingest_preparsed(self, sidecar, issuer_idx, valid, host_rows,
@@ -1665,6 +1856,11 @@ class HostSnapshotAggregator(TpuAggregator):
             "use TpuAggregator/ShardedAggregator to ingest")
 
     def _device_step_preparsed(self, *args, **kwargs):
+        raise RuntimeError(
+            "HostSnapshotAggregator is read-only (reports); "
+            "use TpuAggregator/ShardedAggregator to ingest")
+
+    def _device_step_staged(self, *args, **kwargs):
         raise RuntimeError(
             "HostSnapshotAggregator is read-only (reports); "
             "use TpuAggregator/ShardedAggregator to ingest")

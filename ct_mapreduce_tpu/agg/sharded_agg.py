@@ -216,6 +216,29 @@ class ShardedAggregator(TpuAggregator):
     def _table_fill_exact(self) -> int:
         return self.dedup.total_count()
 
+    # The mesh step reads its rows host-side (shard routing is a
+    # host-computed partition); the staging ring must not ship the
+    # stacked buffer to one device.
+    staged_h2d = False
+
+    def ingest_staged_submit(self, data, length, issuer_idx, valid,
+                             host_chunks):
+        """Staged lane over the mesh: the fused single-chip envelope
+        doesn't apply (the walker step here is a shard_map program with
+        its own per-chunk dispatch), so the staging ring's K chunks
+        flatten into ONE :meth:`ingest_packed_submit` — per-chunk mesh
+        steps dispatched back to back with a single deferred fold, so
+        the sink-side contract (one pending per staged flush, drain
+        fully async) is identical across topologies."""
+        k_chunks, b = np.asarray(length).shape
+        flat = np.asarray(data).reshape(k_chunks * b, -1)
+        return self.ingest_packed_submit(
+            flat,
+            np.asarray(length, np.int32).reshape(-1),
+            np.asarray(issuer_idx, np.int32).reshape(-1),
+            np.asarray(valid, bool).reshape(-1),
+        )
+
     def _device_step_preparsed(self, serials, serial_len, nah,
                                issuer_idx, insertable, flag_cap: int):
         """Pre-parsed lane over the mesh, host-routed.

@@ -101,6 +101,8 @@ def build_sink(config: CTConfig, database, backend=None):
                               decode_threads=config.decode_threads,
                               overlap_workers=config.overlap_workers,
                               preparsed=config.preparsed_ingest or None,
+                              chunks_per_dispatch=config.chunks_per_dispatch,
+                              staging_depth=config.staging_depth,
                               ), model
     sink = DatabaseSink(
         database,

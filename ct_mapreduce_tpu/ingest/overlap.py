@@ -227,6 +227,17 @@ class OverlapIngestPipeline:
                           value=float(hw["drain_queue"]))
         metrics.set_gauge("overlap", "drain_queue_capacity",
                           value=float(self.queue_depth))
+        stage = getattr(self._sink, "staging_depths", None)
+        if stage is not None:
+            depths = stage()
+            if depths:
+                metrics.set_gauge(
+                    "overlap", "staging_ring_highwater",
+                    value=float(depths["staging_ring_highwater"]))
+                metrics.set_gauge(
+                    "overlap", "staging_ring_capacity",
+                    value=float(depths["staging_ring_capacity"]))
+                hw.update(depths)
         return hw
 
     def queue_depths(self) -> dict[str, int]:
@@ -236,7 +247,7 @@ class OverlapIngestPipeline:
         with self._hw_lock:
             prepared = self._prepared_in_use
             hw = dict(self.highwater)
-        return {
+        depths = {
             "prepared": prepared,
             "prepared_capacity": self._max_prepared,
             "prepared_highwater": hw["prepared"],
@@ -244,6 +255,12 @@ class OverlapIngestPipeline:
             "drain_queue_capacity": self.queue_depth,
             "drain_queue_highwater": hw["drain_queue"],
         }
+        # Staged mode adds the third bounded stage: the sink's staging
+        # ring (decoded-and-staged but undispatched chunks).
+        stage = getattr(self._sink, "staging_depths", None)
+        if stage is not None:
+            depths.update(stage())
+        return depths
 
     # -- stage bodies ----------------------------------------------------
     def _decode_one(self, pairs):
@@ -259,9 +276,15 @@ class OverlapIngestPipeline:
         while True:
             item = self._order_q.get()
             if item is _SENTINEL:
+                self._flush_sink_staging()
                 self._drain_q.put(_SENTINEL)
                 return
             if isinstance(item, threading.Event):  # drain_all barrier
+                # A barrier covers everything SUBMITTED so far — in
+                # staged mode that includes chunks parked in the sink's
+                # staging ring, which must dispatch (as a padded
+                # partial envelope) before the marker passes.
+                self._flush_sink_staging()
                 self._drain_q.put(item)
                 continue
             try:
@@ -298,12 +321,33 @@ class OverlapIngestPipeline:
                 continue
             finally:
                 self._release_prepared()
-            for kind, payload, der_of in work:
-                self._drain_q.put((kind, payload, der_of))
-                depth = self._drain_q.qsize()
-                with self._hw_lock:
-                    if depth > self.highwater["drain_queue"]:
-                        self.highwater["drain_queue"] = depth
+            self._enqueue_drain(work)
+
+    def _enqueue_drain(self, work) -> None:
+        for kind, payload, der_of in work:
+            self._drain_q.put((kind, payload, der_of))
+            depth = self._drain_q.qsize()
+            with self._hw_lock:
+                if depth > self.highwater["drain_queue"]:
+                    self.highwater["drain_queue"] = depth
+
+    def _flush_sink_staging(self) -> None:
+        """Dispatch whatever sits in the sink's staging ring (staged
+        mode only; a sink without a ring no-ops). Runs on the submit
+        thread so ring access stays serialized under the dispatch
+        lock. After a latched failure the ring is left undispatched —
+        the same already-decoded-work-is-dropped contract a decode
+        failure applies."""
+        flush = getattr(self._sink, "_flush_staging_items", None)
+        if flush is None or self._failed.is_set():
+            return
+        try:
+            with self._sink._dispatch_lock:
+                work = flush()
+        except BaseException as err:
+            self._fail(err)
+            return
+        self._enqueue_drain(work)
 
     def _drain_loop(self) -> None:
         while True:

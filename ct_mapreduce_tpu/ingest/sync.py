@@ -51,6 +51,30 @@ from ct_mapreduce_tpu.telemetry import metrics, trace
 ENTRY_QUEUE_CAPACITY = 16384  # ct-fetch.go:132
 
 
+def resolve_staging(chunks_per_dispatch: int = 0,
+                    staging_depth: int = 0) -> tuple[int, int]:
+    """Resolve the staged-device-queue knobs: explicit value (config
+    directive / kwarg) > ``CTMR_CHUNKS_PER_DISPATCH`` /
+    ``CTMR_STAGING_DEPTH`` env > defaults (K=1 — legacy per-chunk
+    dispatch; depth 2 — double buffer). Unparseable env values are
+    ignored, matching the config layer's tolerance."""
+    import os
+
+    def env_int(name: str) -> int:
+        try:
+            return int(os.environ.get(name, "0") or 0)
+        except ValueError:
+            return 0
+
+    k = int(chunks_per_dispatch or 0)
+    if k <= 0:
+        k = env_int("CTMR_CHUNKS_PER_DISPATCH")
+    d = int(staging_depth or 0)
+    if d <= 0:
+        d = env_int("CTMR_STAGING_DEPTH")
+    return max(1, k), max(1, d if d > 0 else 2)
+
+
 class EntrySink(Protocol):
     def store(self, entry: DecodedEntry, log_url: str) -> None: ...
     def flush(self) -> None: ...
@@ -131,7 +155,8 @@ class AggregatorSink:
     def __init__(self, aggregator, flush_size: int = 4096, backend=None,
                  device_queue_depth: int = 2, decode_workers: int = 0,
                  overlap_workers: int = 0, preparsed: Optional[bool] = None,
-                 decode_threads: int = 0):
+                 decode_threads: int = 0, chunks_per_dispatch: int = 0,
+                 staging_depth: int = 0):
         self.aggregator = aggregator
         self.flush_size = flush_size
         # Optional durable backend (certPath): first-seen certs get the
@@ -189,6 +214,20 @@ class AggregatorSink:
 
             preparsed = os.environ.get("CTMR_PREPARSED", "0") == "1"
         self.preparsed = bool(preparsed)
+        # Staged device queue (round 11): `chunksPerDispatch` (K) > 1
+        # routes walker-lane chunks through a staging ring — K decoded
+        # chunks stack into one pinned host buffer, ship in ONE H2D
+        # put, and run as ONE resident K-chunk device envelope
+        # (pipeline.staged_core), dividing the per-dispatch Python +
+        # readback toll by K. `stagingDepth` bounds envelopes that are
+        # submitted-but-unfolded (the double-buffer depth). Explicit
+        # kwarg > CTMR_CHUNKS_PER_DISPATCH / CTMR_STAGING_DEPTH env >
+        # defaults (K=1 → legacy per-chunk dispatch; depth 2).
+        self.chunks_per_dispatch, self.staging_depth = resolve_staging(
+            chunks_per_dispatch, staging_depth)
+        self._staging: list[_PreparedChunk] = []  # the ring (FIFO)
+        self._staging_hw = 0  # high-water occupancy
+        self._staging_bufs: dict[tuple, tuple] = {}  # (K,B,L) → (bufs, idx)
         self.overlap_workers = max(0, int(overlap_workers))
         self._overlap = None
         if self.overlap_workers:
@@ -196,7 +235,11 @@ class AggregatorSink:
 
             self._overlap = OverlapIngestPipeline(
                 self, decode_workers=self.overlap_workers,
-                queue_depth=max(1, self.device_queue_depth),
+                # In staged mode the drain bound counts ENVELOPES, and
+                # stagingDepth is that double-buffer depth.
+                queue_depth=(self.staging_depth
+                             if self.chunks_per_dispatch > 1
+                             else max(1, self.device_queue_depth)),
             )
 
     def store(self, entry: DecodedEntry, log_url: str) -> None:
@@ -253,7 +296,12 @@ class AggregatorSink:
                 self._inflight.append((item[1], item[2]))
             else:  # oversized-lane result: fold PEMs immediately
                 self._store_pems(item[1], item[2])
-        self._drain_inflight(self.device_queue_depth)
+        # Staged mode counts in-flight ENVELOPES against stagingDepth
+        # (the double-buffer bound); the legacy per-chunk path keeps
+        # deviceQueueDepth semantics.
+        self._drain_inflight(self.staging_depth
+                             if self.chunks_per_dispatch > 1
+                             else self.device_queue_depth)
 
     def _prepare_chunk(self, pairs: list[tuple[str, str]]) -> "_PreparedChunk":
         """Stage 1 — decode + pack + H2D submit, NO aggregator-state
@@ -412,7 +460,10 @@ class AggregatorSink:
         # (its device inputs are the compact per-lane fields).
         data_host = data
         if (sidecar is None and valid.any()
+                and self.chunks_per_dispatch <= 1
                 and data.shape[0] % self.aggregator.batch_size == 0):
+            # Staged mode skips the per-chunk put: the staging ring
+            # ships the stacked [K, B, L] buffer in one H2D instead.
             import jax
 
             # Timing note: device_put ENQUEUES asynchronously, so this
@@ -427,6 +478,144 @@ class AggregatorSink:
             walker_fallback=walker_fallback,
         )
 
+    # -- staged device queue (round 11) ----------------------------------
+    def _submit_staged(self, prep: "_PreparedChunk") -> list[tuple]:
+        """Staged walker lane: enqueue the prepared chunk into the
+        staging ring; every K chunks the ring stacks into one pinned
+        host buffer, ships in ONE H2D put, and dispatches as ONE
+        resident K-chunk envelope. Caller holds ``_dispatch_lock`` (the
+        ring is only ever touched under it)."""
+        items: list[tuple] = []
+        ring = self._staging
+        # Ring chunks must share a row width (the narrow/wide
+        # pre-decode bucketing can alternate): a mismatch flushes
+        # what's staged before the new chunk enters.
+        if ring and prep.valid.any() and (
+                ring[0].host_data.shape[1] != prep.host_data.shape[1]):
+            items += self._flush_staging_items()
+        # Chunks carrying host-exact entries (oversized certs, rare
+        # walker-undecidable sidecar lanes) dispatch immediately:
+        # ring-flush → stage → flush again, so the serial path's
+        # intra-chunk order (device lanes, then fallback, then
+        # oversized) — and with it the dedup attribution — is
+        # preserved exactly.
+        host_exact = bool(prep.oversized or prep.walker_fallback)
+        if host_exact:
+            items += self._flush_staging_items()
+        if prep.valid.any():
+            ring.append(prep)
+            depth = len(ring)
+            if depth > self._staging_hw:
+                self._staging_hw = depth
+            metrics.set_gauge("ingest", "staging_ring", value=float(depth))
+            if host_exact or depth >= self.chunks_per_dispatch:
+                items += self._flush_staging_items()
+        if prep.walker_fallback:
+            fb = prep.walker_fallback
+            res_fb = self.aggregator.ingest(fb)
+            items.append(("result", res_fb, lambda pos, _o=fb: _o[pos][0]))
+        if prep.oversized:
+            oversized = prep.oversized
+            res_over = self.aggregator.ingest(oversized)
+            items.append((
+                "result", res_over, lambda pos, _o=oversized: _o[pos][0],
+            ))
+        metrics.incr_counter(
+            "ct-fetch", "insertCertificate",
+            value=float(int(prep.valid.sum()) + len(prep.oversized)
+                        + len(prep.walker_fallback)),
+        )
+        return items
+
+    def _staging_buffer(self, k: int, b: int, width: int) -> np.ndarray:
+        """One of the cycled pinned host staging buffers for this
+        envelope shape. ``stagingDepth`` bounds envelopes in flight, so
+        ``stagingDepth + 2`` buffers guarantee a buffer is only reused
+        after the envelope that shipped from it has been folded (its
+        transfer long since complete)."""
+        key = (k, b, width)
+        bufs, idx = self._staging_bufs.get(key, ([], -1))
+        if len(bufs) < self.staging_depth + 2:
+            bufs.append(np.zeros((k, b, width), np.uint8))
+            idx = len(bufs) - 1
+        else:
+            idx = (idx + 1) % len(bufs)
+        self._staging_bufs[key] = (bufs, idx)
+        return bufs[idx]
+
+    def _flush_staging_items(self) -> list[tuple]:
+        """Dispatch the staging ring as one resident envelope (no-op on
+        an empty ring). Caller holds ``_dispatch_lock``. A partial ring
+        (final flush, host-exact chunk, shape change) pads the K axis
+        with all-invalid chunks so the envelope keeps its compiled
+        shape."""
+        ring, self._staging = self._staging, []
+        if not ring:
+            return []
+        k_env = self.chunks_per_dispatch
+        k_real = len(ring)
+        b = max(p.host_data.shape[0] for p in ring)
+        width = ring[0].host_data.shape[1]
+        agg = self.aggregator
+        # The mesh-sharded step routes rows host-side (staged_h2d is
+        # False there): it keeps the stacked rows on host, so the
+        # buffer must be fresh per envelope, not a recycled one.
+        reuse = getattr(agg, "staged_h2d", True)
+        buf = (self._staging_buffer(k_env, b, width) if reuse
+               else np.zeros((k_env, b, width), np.uint8))
+        length = np.zeros((k_env, b), np.int32)
+        issuer_idx = np.zeros((k_env, b), np.int32)
+        valid = np.zeros((k_env, b), bool)
+        host_chunks: list[np.ndarray] = []
+        for k, p in enumerate(ring):
+            n_k = p.host_data.shape[0]
+            buf[k, :n_k] = p.host_data
+            # Stale rows past n_k (buffer reuse) are harmless — their
+            # lanes stay invalid and the fold never reads them.
+            length[k, :n_k] = p.length
+            issuer_idx[k, :n_k] = p.issuer_idx
+            valid[k, :n_k] = p.valid
+            host_chunks.append(p.host_data)
+        metrics.set_gauge("ingest", "staging_ring", value=0.0)
+        metrics.add_sample("ingest", "dispatch_chunks", value=float(k_real))
+        data = buf
+        if reuse:
+            import jax
+
+            # H2D of the whole envelope, enqueued BEFORE the dispatch:
+            # device_put is asynchronous on accelerator backends, so
+            # this transfer rides alongside the previous envelope's
+            # compute; block_until_ready never runs on the submit side.
+            with trace.span("ingest.h2d", cat="ingest", chunks=k_real,
+                            bytes=int(buf.nbytes)), \
+                    metrics.measure("ct-fetch", "h2dSubmit"):
+                data = jax.device_put(buf)
+            metrics.incr_counter("ingest", "h2d_bytes",
+                                 value=float(buf.nbytes))
+        pending = agg.ingest_staged_submit(
+            data, length, issuer_idx, valid, host_chunks)
+        decs = [p.dec for p in ring]
+
+        def der_of(pos, _decs=decs, _b=b):
+            k, j = divmod(pos, _b)
+            d = _decs[k]
+            return d.data[j, : d.length[j]].tobytes()
+
+        return [("pending", pending, der_of)]
+
+    def staging_depths(self) -> dict[str, int]:
+        """Staging-ring occupancy for ``/healthz`` (merged into the
+        overlap pipeline's ``queue_depths``): a ring pinned below K
+        while the drain is saturated is the drain-starvation signature
+        the prepared/drain gauges alone can't show."""
+        if self.chunks_per_dispatch <= 1:
+            return {}
+        return {
+            "staging_ring": len(self._staging),
+            "staging_ring_capacity": self.chunks_per_dispatch,
+            "staging_ring_highwater": self._staging_hw,
+        }
+
     def _submit_chunk(self, prep: "_PreparedChunk") -> list[tuple]:
         """Stage 2 — dispatch the device step(s) for a prepared chunk.
         Caller MUST hold ``_dispatch_lock`` (one device stream; the
@@ -434,7 +623,14 @@ class AggregatorSink:
         items: ``("pending", PendingIngest, der_of)`` entries whose
         ``complete()`` is stage 3, and ``("result", IngestResult,
         der_of)`` entries (the rare oversized exact lane, already
-        complete) that only need PEM folding."""
+        complete) that only need PEM folding.
+
+        With ``chunksPerDispatch`` > 1 the walker lane detours through
+        the staging ring (``_submit_staged``): a chunk may return no
+        drain items (staged, awaiting ring mates) or one pending
+        covering a whole K-chunk envelope."""
+        if self.chunks_per_dispatch > 1 and prep.sidecar is None:
+            return self._submit_staged(prep)
         items: list[tuple] = []
         if prep.valid.any():
             if prep.sidecar is not None:
@@ -514,6 +710,14 @@ class AggregatorSink:
             metrics.add_sample("ct-fetch", "dispatchLockWait",
                                value=time.monotonic() - t_lock)
             with metrics.measure("ct-fetch", "storeCertificate"):
+                # Serial staged mode: a partial ring must dispatch at
+                # the barrier (the overlap path flushed it on the
+                # submit thread inside drain_all above).
+                for item in self._flush_staging_items():
+                    if item[0] == "pending":
+                        self._inflight.append((item[1], item[2]))
+                    else:
+                        self._store_pems(item[1], item[2])
                 self._drain_inflight(0)
 
     def close(self) -> None:

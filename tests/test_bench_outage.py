@@ -68,7 +68,11 @@ def test_watchdog_mid_measurement_emits_partial_rate():
             "CT_BENCH_LOG2_CAPACITY": "24",
             "CT_BENCH_SECS": "9999",  # never finish on its own
             "CT_BENCH_EXEC_SECS": "2",
-            "CT_BENCH_WATCHDOG_SECS": "75",
+            # Must fire AFTER >=1 timed chunk: the 16K-lane headline
+            # compiles in ~8 s on this image and chunks take ~2 s, so
+            # 35 s leaves >3x margin while keeping this (deliberate
+            # wait) test inside the tier-1 budget.
+            "CT_BENCH_WATCHDOG_SECS": "35",
         },
         timeout=300,
     )

@@ -137,6 +137,36 @@ def test_observability_directives(tmp_path):
     assert "tracePath" in usage and "metricsPort" in usage
 
 
+def test_staged_queue_directives(tmp_path, monkeypatch):
+    """stagingDepth / chunksPerDispatch (round 11): ini + env
+    layering, int parse, defaults-off, usage() — and the sink-side
+    CTMR_* env fallback behind the config value."""
+    ini = tmp_path / "ct.ini"
+    ini.write_text("chunksPerDispatch = 8\nstagingDepth = 3\n")
+    cfg = CTConfig.load(argv=["--config", str(ini)], env={})
+    assert cfg.chunks_per_dispatch == 8
+    assert cfg.staging_depth == 3
+    # Env beats file; unparseable env falls back to the file value.
+    cfg2 = CTConfig.load(argv=["--config", str(ini)],
+                         env={"chunksPerDispatch": "16",
+                              "stagingDepth": "4"})
+    assert cfg2.chunks_per_dispatch == 16 and cfg2.staging_depth == 4
+    cfg3 = CTConfig.load(argv=["--config", str(ini)],
+                         env={"chunksPerDispatch": "banana"})
+    assert cfg3.chunks_per_dispatch == 8
+    # Defaults: 0 = resolve via CTMR_* env, then legacy (K=1, depth 2).
+    off = CTConfig.load(argv=[], env={})
+    assert off.chunks_per_dispatch == 0 and off.staging_depth == 0
+    from ct_mapreduce_tpu.ingest.sync import resolve_staging
+
+    monkeypatch.delenv("CTMR_CHUNKS_PER_DISPATCH", raising=False)
+    monkeypatch.delenv("CTMR_STAGING_DEPTH", raising=False)
+    assert resolve_staging(off.chunks_per_dispatch,
+                           off.staging_depth) == (1, 2)
+    usage = CTConfig().usage()
+    assert "chunksPerDispatch" in usage and "stagingDepth" in usage
+
+
 def test_query_port_directive(tmp_path):
     """queryPort (ISSUE 5): ini + env layering, int parse, usage()."""
     ini = tmp_path / "ct.ini"

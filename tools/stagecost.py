@@ -26,6 +26,15 @@ device-value read. Stage deltas then give real per-stage costs:
             with byte-exact parity asserted at each point; ns/entry
             per thread count is the host-feed scaling curve
             (CT_SC_DECODE_N overrides the wire batch size).
+  dispatch — per-chunk Python-dispatch + H2D overhead of the staged
+            device queue: the same 8 chunks run as 8/K resident
+            envelopes at K ∈ {1, 2, 4, 8} chunks/dispatch
+            (pipeline.staged_core), each dispatch paying one
+            device_put + one jit call; byte parity of the packed
+            readbacks and the final table is asserted against K=1.
+            The wall delta across K is the per-dispatch toll that
+            staging amortizes (CT_SC_DISPATCH_B overrides the chunk
+            lane count).
 
 Run:  python tools/stagecost.py [batch] [stage ...]
 """
@@ -331,6 +340,65 @@ def main() -> None:
                 "parity exact)")
         return curve
 
+    def run_dispatch():
+        """Staged-envelope K-curve: fixed total work (8 chunks of B
+        lanes), varying chunks/dispatch. Every dispatch is the REAL
+        production shape — host rows → one device_put → one
+        ingest_step_staged call — so the K=1 vs K=8 wall delta is
+        exactly the per-dispatch Python + H2D + readback toll the
+        staging ring amortizes. Byte parity (packed readbacks + final
+        table rows) is asserted against K=1 at every point."""
+        b = int(os.environ.get("CT_SC_DISPATCH_B", "1024"))
+        n_chunks = 8
+        tpl_d = syncerts.make_template(issuer_cn="Dispatch CA")
+        datas_d, lens_d = syncerts.build_device_batches(
+            tpl_d, n_chunks, b, pad_len)
+        datas_np = np.asarray(datas_d, np.uint8)  # [8, B, L] host rows
+        lens_np = np.asarray(lens_d, np.int32)
+        iidx_np = np.zeros((n_chunks, b), np.int32)
+        valid_np = np.ones((n_chunks, b), bool)
+        dcap = 1 << max(14, (4 * n_chunks * b).bit_length())
+        say(f"  dispatch: {n_chunks} chunks x {b} lanes, pad {pad_len}")
+
+        def sweep(k):
+            table = mk_table(dcap)
+            packs = []
+            t0 = time.perf_counter()
+            for g in range(n_chunks // k):
+                sl = slice(g * k, (g + 1) * k)
+                data = jax.device_put(datas_np[sl])  # the H2D the
+                # staging ring ships per dispatch
+                table, out = pipeline.ingest_step_staged(
+                    table, data, lens_np[sl], iidx_np[sl], valid_np[sl],
+                    jnp.int32(now_hour),
+                    jnp.int32(packing.DEFAULT_BASE_HOUR),
+                    no_cn, no_cn_lens)
+                packs.append(out.packed)
+            packed = np.concatenate(
+                [np.asarray(p) for p in packs], axis=0)  # sync point
+            rows = np.asarray(table.rows)
+            return time.perf_counter() - t0, packed, rows
+
+        base = None
+        for k in (1, 2, 4, 8):
+            sweep(k)  # compile + warmup
+            best = None
+            for _ in range(3):
+                dt, packed, rows = sweep(k)
+                best = dt if best is None else min(best, dt)
+            if base is None:
+                base = (packed, rows, best)
+            else:
+                assert np.array_equal(base[0], packed), (
+                    f"dispatch K={k}: packed readback diverged from K=1")
+                assert np.array_equal(base[1], rows), (
+                    f"dispatch K={k}: table rows diverged from K=1")
+            per_chunk = best / n_chunks
+            say(f"dispatch K={k:<2d} {best * 1e3:9.2f} ms/8chunks  "
+                f"{per_chunk * 1e3:8.2f} ms/chunk  "
+                f"{per_chunk / b * 1e9:8.1f} ns/entry  "
+                f"({base[2] / best:.2f}x vs K=1, parity exact)")
+
     stages = [
         ("read", s_read), ("pack", s_pack), ("pack2", s_pack2),
         ("parse", s_parse),
@@ -340,6 +408,10 @@ def main() -> None:
     if not only or "decode" in only:
         run_decode()
     if only == {"decode"}:
+        return
+    if not only or "dispatch" in only:
+        run_dispatch()
+    if only == {"dispatch"}:
         return
     for name, fn in stages:
         if only and name not in only:
