@@ -1532,6 +1532,135 @@ def run_smoke() -> dict:
     log(f"smoke serve shed leg: {len(shed_rej)}/16 rejected overloaded, "
         f"{len(shed_ok)} served after the stall")
 
+    # (2g) serve-device leg (ISSUE 7): the replicated device tier over
+    # the same aggregator — ≥2 epoch-pinned replicas serving
+    # round-robin through the jitted contains kernels while a
+    # background thread keeps ingesting, with the hot-serial cache in
+    # front of the batcher on a zipf-ish probe mix (a hot working set
+    # probed repeatedly). Gates, all span-/counter-derived: exact
+    # parity, serve.contains_device execution spans present, ≥2
+    # distinct replicas actually answered batches, cache hits > 0, and
+    # batch occupancy (mean lanes/batch) still > 1 for the misses.
+    from ct_mapreduce_tpu.serve.server import MembershipOracle
+    from ct_mapreduce_tpu.telemetry.metrics import get_sink as _get_sink
+
+    sd_idx = [agg.registry.index_of_issuer_id(idents[k][0])
+              for k in (0, 1)]
+    sd_eh = [_hostder.parse_cert(tpls[k].leaf_der).not_after_unix_hour
+             for k in (0, 1)]
+
+    def sd_item(j):
+        k = j % 2
+        tpl = tpls[k]
+        der = syncerts.stamp_serial(tpl, j)
+        return (sd_idx[k], sd_eh[k],
+                der[tpl.serial_off : tpl.serial_off + tpl.serial_len])
+
+    dev_oracle = MembershipOracle(
+        agg, max_batch=128, max_delay_s=0.003, max_staleness_s=0.3,
+        device=True, replicas=2, cache_size=512)
+    dev_oracle.snapshots.warm()
+    # Compile the contains widths outside the timed window (keys in
+    # [6·total, 7·total): never probed by any leg, absent forever).
+    for w in (16, 32, 64, 128):
+        dev_oracle.query_raw([sd_item(6 * total + k) for k in range(w)])
+    sd_c0 = dict(_get_sink().snapshot().get("counters", {}))
+    t_sd0 = ttrace.now_us()
+    sd_stop = _threading.Event()
+
+    def sd_ingest():
+        # Fresh serials [5·total, 6·total): the table keeps stepping
+        # (and possibly growing) while the replicas stagger-refresh.
+        j0 = 5 * total
+        while not sd_stop.is_set() and j0 < 6 * total:
+            agg.ingest([(syncerts.stamp_serial(tpls[j % 2], j),
+                         tpls[j % 2].issuer_der)
+                        for j in range(j0, j0 + 256)])
+            j0 += 256
+
+    sd_mism: list = []
+
+    def sd_client(seed):
+        rng = np.random.default_rng(7000 + seed)
+        hot = [int(rng.integers(total)) for _ in range(8)]
+        for _ in range(40):
+            r = rng.random()
+            if r < 0.7:  # the zipf-ish head: repeats ⇒ cache hits
+                j = hot[int(rng.integers(len(hot)))]
+            elif r < 0.85:
+                j = int(rng.integers(total))  # cold present
+            else:
+                j = int(rng.integers(3 * total, 4 * total))  # absent
+            res = dev_oracle.query_raw([sd_item(j)])
+            if res[0][0] != (j < total):
+                sd_mism.append((j, res[0][0]))
+
+    sd_bg = _threading.Thread(target=sd_ingest)
+    sd_clients = [_threading.Thread(target=sd_client, args=(s,))
+                  for s in range(12)]
+    t_sd_wall = time.perf_counter()
+    sd_bg.start()
+    for c in sd_clients:
+        c.start()
+    for c in sd_clients:
+        c.join()
+    sd_wall = time.perf_counter() - t_sd_wall
+    sd_stop.set()
+    sd_bg.join()
+    dev_oracle.close()
+    t_sd1 = ttrace.now_us()
+    sd_c1 = _get_sink().snapshot().get("counters", {})
+    if sd_mism:
+        raise BenchError(
+            f"smoke serve-device parity: {len(sd_mism)} wrong answers, "
+            f"first {sd_mism[0]} — the replicated device path is not "
+            "snapshot-consistent under concurrent ingest")
+    sd_spans = [e for e in ttrace.snapshot_events()
+                if e.get("ph") == "X" and t_sd0 <= e["ts"] <= t_sd1]
+    sd_lookups = [e for e in sd_spans if e["name"] == "serve.lookup"]
+    sd_dev_lookups = [e for e in sd_lookups
+                      if e["args"].get("device") == 1]
+    if not sd_dev_lookups:
+        raise BenchError(
+            "smoke serve-device: no device-mode serve.lookup spans — "
+            "the plane fell back to the host mirror")
+    sd_replicas = {e["args"].get("replica") for e in sd_dev_lookups}
+    if len(sd_replicas) < 2:
+        raise BenchError(
+            f"smoke serve-device: only replicas {sd_replicas} answered "
+            "— the pool is not round-robin serving >=2 replicas")
+    sd_contains = [e for e in sd_spans
+                   if e["name"] == "serve.contains_device"]
+    if not sd_contains:
+        raise BenchError(
+            "smoke serve-device: no serve.contains_device execution "
+            "spans — membership did not run the jitted kernels")
+    sd_batches = [e for e in sd_spans if e["name"] == "serve.batch"]
+    sd_mean_lanes = (sum(e["args"]["lanes"] for e in sd_batches)
+                     / len(sd_batches)) if sd_batches else 0.0
+    if sd_mean_lanes <= 1.0:
+        raise BenchError(
+            f"smoke serve-device batching: mean lanes/batch "
+            f"{sd_mean_lanes:.2f} <= 1 — misses are not coalescing")
+    sd_hits = (sd_c1.get("serve.cache_hit", 0.0)
+               - sd_c0.get("serve.cache_hit", 0.0))
+    sd_misses = (sd_c1.get("serve.cache_miss", 0.0)
+                 - sd_c0.get("serve.cache_miss", 0.0))
+    if sd_hits <= 0:
+        raise BenchError(
+            "smoke serve-device cache: zero hits on a zipf-ish probe "
+            "mix — the hot-serial cache is not serving")
+    sd_fallback = (sd_c1.get("serve.device_fallback", 0.0)
+                   - sd_c0.get("serve.device_fallback", 0.0))
+    log(f"smoke serve-device: {12 * 40} zipf-ish queries in "
+        f"{sd_wall:.2f}s under concurrent ingest — parity exact, "
+        f"{len(sd_replicas)} replicas served "
+        f"({len(sd_dev_lookups)} device lookups, {len(sd_contains)} "
+        f"contains execs), cache {sd_hits:.0f} hits / "
+        f"{sd_misses:.0f} misses "
+        f"({sd_hits / max(1.0, sd_hits + sd_misses):.0%}), mean "
+        f"{sd_mean_lanes:.1f} lanes/batch, fallbacks {sd_fallback:.0f}")
+
     # (3) the overlap inequality, on the overlapped run itself.
     budget_sum = over["decode_s"] + over["device_wait_s"] + over["drain_s"]
     ratio = over["wall"] / budget_sum if budget_sum > 0 else 99.0
@@ -1574,6 +1703,15 @@ def run_smoke() -> dict:
         "smoke_serve_wait_p50_ms": round(p50_wait * 1e3, 2),
         "smoke_serve_wait_p99_ms": round(p99_wait * 1e3, 2),
         "smoke_serve_shed": len(shed_rej),
+        "smoke_serve_dev_parity": 1,
+        "smoke_serve_dev_replicas": len(sd_replicas),
+        "smoke_serve_dev_lookups": len(sd_dev_lookups),
+        "smoke_serve_dev_contains_spans": len(sd_contains),
+        "smoke_serve_dev_cache_hits": int(sd_hits),
+        "smoke_serve_dev_cache_hit_rate": round(
+            sd_hits / max(1.0, sd_hits + sd_misses), 3),
+        "smoke_serve_dev_mean_batch_lanes": round(sd_mean_lanes, 2),
+        "smoke_serve_dev_fallbacks": int(sd_fallback),
         **({"smoke_trace_path": trace_path} if trace_path else {}),
         **({"smoke_preparsed_wall_s": round(pre["wall"], 3),
             "smoke_preparsed_flag_bytes": int(pre["flag_bytes"]),

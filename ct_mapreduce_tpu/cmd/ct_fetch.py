@@ -203,7 +203,10 @@ def main(argv: list[str] | None = None) -> int:
 
         try:
             query_server = QueryServer(
-                model.aggregator, config.query_port).start()
+                model.aggregator, config.query_port,
+                device=config.serve_device,
+                replicas=config.serve_replicas,
+                cache_size=config.serve_cache_size).start()
             print(f"query endpoint: :{query_server.port}/query "
                   f"+ /issuer + /getcert", file=sys.stderr)
         except OSError as err:

@@ -74,6 +74,12 @@ class CTConfig:
     # (telemetry/promhttp.py; 0 = off)
     query_port: int = 0  # batched membership-oracle JSON API port
     # (serve/server.py; 0 = off; tpu backend only)
+    serve_replicas: int = 0  # epoch-pinned snapshot replicas in the
+    # query plane's pool (0 = CTMR_SERVE_REPLICAS env, then 2)
+    serve_device: bool = True  # serve membership from pinned device
+    # copies (jitted contains); host-numpy fallback when no copy pins
+    serve_cache_size: int = 0  # hot-serial result cache entries
+    # (0 = CTMR_SERVE_CACHE_SIZE env, then 4096; -1 disables)
     verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
@@ -115,6 +121,9 @@ class CTConfig:
         "tracePath": ("trace_path", str),
         "metricsPort": ("metrics_port", int),
         "queryPort": ("query_port", int),
+        "serveReplicas": ("serve_replicas", int),
+        "serveDevice": ("serve_device", bool),
+        "serveCacheSize": ("serve_cache_size", int),
     }
 
     @classmethod
@@ -277,6 +286,15 @@ class CTConfig:
             "this port (0 disables)",
             "queryPort = Serve the batched membership-oracle JSON API "
             "(/query, /issuer, /getcert) on this port (0 disables)",
+            "serveReplicas = epoch-pinned snapshot replicas in the "
+            "query plane's pool (0 = CTMR_SERVE_REPLICAS, then 2; "
+            "staggered refresh, round-robin serving)",
+            "serveDevice = serve membership from pinned device copies "
+            "via the jitted contains kernels (host-numpy fallback when "
+            "no copy can pin; false forces the host mirror)",
+            "serveCacheSize = hot-serial result cache entries in front "
+            "of the batcher (0 = CTMR_SERVE_CACHE_SIZE, then 4096; "
+            "-1 disables)",
         ]
         return "\n".join(lines)
 

@@ -70,9 +70,15 @@ class MicroBatcher:
 
     ``run_batch(items) -> results`` must be length-preserving; it runs
     on the single worker thread, so an oracle that is not itself
-    thread-safe needs no locking. One request's items are never split
-    across batches (its results come from one epoch); a request larger
-    than ``max_batch`` runs as its own oversized batch.
+    thread-safe needs no locking. A request of up to ``max_batch``
+    items is never split across batches (its results come from one
+    epoch); a bulk submission LARGER than ``max_batch`` is split into
+    max_batch-sized sub-requests at admission (``serve.split_requests``)
+    and reassembled in order — so oversized bulks coalesce legally with
+    concurrent traffic instead of forcing one illegal oversized batch,
+    at the cost that their results may span epochs (each sub-batch is
+    individually epoch-consistent; callers that surface an epoch should
+    report the minimum).
     """
 
     def __init__(
@@ -116,19 +122,33 @@ class MicroBatcher:
                     f"admission queue full ({self._queued_lanes} lanes "
                     f"queued, cap {self.max_queue_lanes}); retry later")
             deadline = None if timeout_s is None else now + timeout_s
-            p = _Pending(items, deadline, now)
-            self._queue.append(p)
+            if n <= self.max_batch:
+                parts = [_Pending(items, deadline, now)]
+            else:
+                # Oversized bulk: admit as max_batch-sized sub-requests
+                # under this ONE admission decision (all or shed), so
+                # the worker can legally coalesce and cap every batch.
+                incr_counter("serve", "split_requests")
+                parts = [
+                    _Pending(items[i : i + self.max_batch], deadline, now)
+                    for i in range(0, n, self.max_batch)
+                ]
+            self._queue.extend(parts)
             self._queued_lanes += n
             set_gauge("serve", "queue_lanes", value=float(self._queued_lanes))
             incr_counter("serve", "requests")
             incr_counter("serve", "lanes", value=float(n))
             self._cv.notify()
         with trace.span("serve.wait", cat="serve", lanes=n):
-            p.done.wait()
+            for p in parts:
+                p.done.wait()
         add_sample("serve", "wait_s", value=time.monotonic() - now)
-        if p.error is not None:
-            raise p.error
-        return p.result
+        err = next((p.error for p in parts if p.error is not None), None)
+        if err is not None:
+            raise err
+        if len(parts) == 1:
+            return parts[0].result
+        return [r for p in parts for r in p.result]
 
     def queue_lanes(self) -> int:
         with self._cv:

@@ -24,16 +24,22 @@ Endpoints:
 
 The oracle half (:class:`MembershipOracle`) is independent of HTTP —
 the bench serve leg and tests drive it in-process — and composes the
-two serving primitives: :class:`~ct_mapreduce_tpu.serve.snapshot.
-SnapshotManager` (epoch-pinned reads) and
-:class:`~ct_mapreduce_tpu.serve.batcher.MicroBatcher` (dynamic
-batching + admission control).
+three serving primitives, hottest first:
+:class:`~ct_mapreduce_tpu.serve.cache.HotSerialCache` (memoized
+answers, epoch-floor validated), :class:`~ct_mapreduce_tpu.serve.
+batcher.MicroBatcher` (dynamic batching + admission control), and
+:class:`~ct_mapreduce_tpu.serve.snapshot.ReplicaPool` (round-robin
+epoch-pinned device views with staggered refresh and automatic host
+fallback). ``serveReplicas`` / ``serveDevice`` / ``serveCacheSize``
+directives (and their ``CTMR_SERVE_*`` env equivalents) tune the tier.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -43,13 +49,44 @@ from ct_mapreduce_tpu.serve.batcher import (
     MicroBatcher,
     Overloaded,
 )
-from ct_mapreduce_tpu.serve.snapshot import SnapshotManager
+from ct_mapreduce_tpu.serve.cache import HotSerialCache
+from ct_mapreduce_tpu.serve.snapshot import ReplicaPool
+from ct_mapreduce_tpu.telemetry import trace
 from ct_mapreduce_tpu.telemetry.metrics import incr_counter
+
+
+def resolve_serve(replicas: int = 0, device: Optional[bool] = None,
+                  cache_size: int = 0) -> tuple[int, bool, int]:
+    """Resolve the serving-tier knobs: explicit value (config directive
+    / kwarg) > ``CTMR_SERVE_REPLICAS`` / ``CTMR_SERVE_DEVICE`` /
+    ``CTMR_SERVE_CACHE_SIZE`` env > defaults (2 replicas; device
+    serving with automatic host fallback; 4096-entry hot-serial
+    cache). ``cache_size < 0`` disables the cache; unparseable env
+    values are ignored, matching the config layer's tolerance."""
+
+    def env_int(name: str) -> int:
+        try:
+            return int(os.environ.get(name, "") or 0)
+        except ValueError:
+            return 0
+
+    r = int(replicas or 0)
+    if r <= 0:
+        r = env_int("CTMR_SERVE_REPLICAS") or 2
+    if device is None:
+        ev = os.environ.get("CTMR_SERVE_DEVICE", "").strip().lower()
+        device = ev not in ("0", "f", "false") if ev else True
+    c = int(cache_size or 0)
+    if c == 0:
+        c = env_int("CTMR_SERVE_CACHE_SIZE") or 4096
+    return r, bool(device), max(0, c)
 
 
 class MembershipOracle:
     """Batched "is serial S known for (issuer, expDate)?" over a live
-    aggregator, with snapshot isolation and dynamic batching."""
+    aggregator: a hot-serial result cache in front of dynamic batching
+    in front of a round-robin pool of epoch-pinned device replicas
+    (host-numpy fallback when no device copy can pin)."""
 
     def __init__(
         self,
@@ -58,26 +95,68 @@ class MembershipOracle:
         max_delay_s: float = 0.002,
         max_queue_lanes: int = 1 << 16,
         max_staleness_s: float = 1.0,
-        device: bool = False,
+        device: Optional[bool] = None,
+        replicas: int = 0,
+        cache_size: int = 0,
     ) -> None:
         self._agg = agg
-        self.snapshots = SnapshotManager(
-            agg, max_staleness_s=max_staleness_s, device=device)
+        replicas, device, cache_size = resolve_serve(
+            replicas, device, cache_size)
+        self.snapshots = ReplicaPool(
+            agg, n_replicas=replicas, max_staleness_s=max_staleness_s,
+            device=device)
+        self.cache = (HotSerialCache(cache_size)
+                      if cache_size > 0 else None)
         self.batcher = MicroBatcher(
             self._run_batch, max_batch=max_batch, max_delay_s=max_delay_s,
             max_queue_lanes=max_queue_lanes)
 
     def _run_batch(self, items: list) -> list:
         view = self.snapshots.view()
-        known = view.lookup(items)
+        with trace.span(
+                "serve.lookup", cat="serve", lanes=len(items),
+                epoch=view.epoch, device=int(view._device),
+                replica=(-1 if view.replica_ix is None
+                         else int(view.replica_ix))):
+            known = view.lookup(items)
         age = view.age_s()
         return [(bool(k), view.epoch, age) for k in known]
 
     def query_raw(self, items: list,
                   timeout_s: Optional[float] = None) -> list:
         """items: [(issuer_idx, exp_hour, serial_bytes)] →
-        [(known, epoch, staleness_s)] (one pinned view per request)."""
-        return self.batcher.submit(items, timeout_s=timeout_s)
+        [(known, epoch, staleness_s)]. Cache hits answer immediately
+        (valid while their epoch >= the pool's floor — equivalent to
+        the round-robin picking the stalest replica); misses batch
+        through the oracle, each sub-batch answered by ONE pinned
+        view."""
+        if self.cache is None:
+            return self.batcher.submit(items, timeout_s=timeout_s)
+        floor = self.snapshots.floor_epoch()
+        now = time.time()
+        n = len(items)
+        out: list = [None] * n
+        miss: list[int] = []
+        for i, it in enumerate(items):
+            e = self.cache.get(it, floor)
+            if e is None:
+                miss.append(i)
+            else:
+                out[i] = (e.known, e.epoch,
+                          max(0.0, now - e.created_wall))
+        if n - len(miss):
+            incr_counter("serve", "cache_hit", value=float(n - len(miss)))
+        if not miss:
+            return out
+        incr_counter("serve", "cache_miss", value=float(len(miss)))
+        res = self.batcher.submit([items[i] for i in miss],
+                                  timeout_s=timeout_s)
+        done = time.time()
+        for i, r in zip(miss, res):
+            out[i] = r
+            self.cache.put(items[i], known=r[0], epoch=r[1],
+                           created_wall=done - r[2])
+        return out
 
     def resolve_issuer(self, issuer_id: str) -> int:
         idx = self._agg.registry.index_of_issuer_id(issuer_id)
@@ -92,15 +171,16 @@ class MembershipOracle:
         return meta
 
     def stats(self) -> dict:
-        view = self.snapshots._view
-        return {
+        body = {
             "queue_lanes": self.batcher.queue_lanes(),
             "queue_cap": self.batcher.max_queue_lanes,
             "max_batch": self.batcher.max_batch,
             "max_delay_s": self.batcher.max_delay_s,
-            "snapshot_epoch": view.epoch if view else 0,
-            "snapshot_age_s": round(view.age_s(), 6) if view else None,
         }
+        body.update(self.snapshots.stats())
+        if self.cache is not None:
+            body.update(self.cache.stats())
+        return body
 
     def close(self) -> None:
         self.batcher.close()
@@ -143,14 +223,16 @@ class QueryServer:
     def __init__(self, agg, port: int, host: str = "0.0.0.0",
                  max_batch: int = 4096, max_delay_s: float = 0.002,
                  max_queue_lanes: int = 1 << 16,
-                 max_staleness_s: float = 1.0, device: bool = False,
-                 transport=None) -> None:
+                 max_staleness_s: float = 1.0,
+                 device: Optional[bool] = None, replicas: int = 0,
+                 cache_size: int = 0, transport=None) -> None:
         self.host = host
         self.port = int(port)
         self.oracle = MembershipOracle(
             agg, max_batch=max_batch, max_delay_s=max_delay_s,
             max_queue_lanes=max_queue_lanes,
-            max_staleness_s=max_staleness_s, device=device)
+            max_staleness_s=max_staleness_s, device=device,
+            replicas=replicas, cache_size=cache_size)
         self._transport = transport
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -175,10 +257,12 @@ class QueryServer:
             return 429, {"error": "overloaded", "detail": str(err)}
         except DeadlineExceeded as err:
             return 504, {"error": "deadline_exceeded", "detail": str(err)}
-        # One request is never split across batches, so every result
-        # shares the request's single pinned view.
-        epoch = results[0][1]
-        staleness = results[0][2]
+        # A result row comes from one pinned view, but rows can span
+        # views (cache hits at older epochs; oversized bulks split into
+        # sub-batches) — report the OLDEST epoch consulted and the
+        # LARGEST staleness, so the surfaced bound errs conservative.
+        epoch = min(r[1] for r in results)
+        staleness = max(r[2] for r in results)
         out = {
             "results": [{"known": known} for known, _, _ in results],
             "epoch": epoch,
@@ -203,6 +287,10 @@ class QueryServer:
             **self.oracle.stats(),
             "shed_total": counters.get("serve.shed", 0.0),
             "batches_total": counters.get("serve.batches", 0.0),
+            "cache_hit_total": counters.get("serve.cache_hit", 0.0),
+            "cache_miss_total": counters.get("serve.cache_miss", 0.0),
+            "device_fallback_total": counters.get(
+                "serve.device_fallback", 0.0),
         }
         return 200, body
 

@@ -25,6 +25,21 @@ Staleness is a bound, not an accident: :class:`SnapshotManager`
 refreshes the view when it is older than ``max_staleness_s`` and every
 response carries the view's epoch and age, so a consumer can tell
 "known as of 0.3 s ago" from "known as of now".
+
+:class:`ReplicaPool` (round 12) is the production tier of the same
+idea: N epoch-pinned **device** views serve round-robin, refreshed
+STAGGERED — one replica swaps to a new epoch at a time, captured and
+pinned on a background thread — so a capture (the table D2H under the
+fold/table locks, which contends with ingest) never stalls the serving
+path, and serving itself runs the jitted ``contains`` kernels on
+pinned device copies instead of sharing a host core with ingest's
+numpy. On a mesh the pool pins **per-shard row blocks**, each on its
+shard's own device (queries route by ``shard_of_np`` exactly like
+ingest lanes); on one chip it pins N full copies. Mixed epochs across
+replicas are safe by construction: every view is individually
+consistent, answers carry the serving view's epoch + age, and
+membership is monotone (a serial is never deleted), so an older
+replica can only under-report within its surfaced staleness.
 """
 
 from __future__ import annotations
@@ -73,6 +88,7 @@ class TableView:
         table_fill: int,
         capacity: int,
         device: bool = False,
+        devices: Optional[list] = None,
         created_wall: Optional[float] = None,
     ) -> None:
         self.epoch = epoch
@@ -95,10 +111,54 @@ class TableView:
         self.created_wall = (time.time() if created_wall is None
                              else created_wall)
         self._device = bool(device)
-        self._dev_rows = None  # lazily pinned device copy (device mode)
+        self._devices = devices  # explicit placement targets (pool mode)
+        self._dev_rows = None  # pinned device copy (device mode)
+        self._dev_blocks = None  # per-shard pinned states (sharded pool)
+        self.replica_ix = None  # pool slot this view serves from
 
     def age_s(self) -> float:
         return max(0.0, time.time() - self.created_wall)
+
+    def pin(self) -> "TableView":
+        """Materialize the device copy NOW, on the caller's (refresh)
+        thread, so the serving path never pays the H2D transfer. In a
+        sharded pool each shard's contiguous row block is placed on
+        its own device — a replica never holds the full global rows on
+        any one chip — wrapped as a ready probe state (rows + count on
+        the SAME device, so the jitted kernel runs without cross-device
+        transfers). Any failure to pin (no device, OOM, backend down)
+        flips the view to the host-numpy mirror permanently — the next
+        epoch's capture retries the device path."""
+        if not self._device:
+            return self
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            if self.n_shards > 1 and self._devices:
+                block = self.rows.shape[0] // self.n_shards
+                state_cls = (buckettable.BucketTable
+                             if self.layout == "bucket"
+                             else hashtable.TableState)
+                blocks = []
+                for s in range(self.n_shards):
+                    dev = self._devices[s % len(self._devices)]
+                    rows = jax.device_put(
+                        self.rows[s * block : (s + 1) * block], dev)
+                    count = jax.device_put(np.zeros((), np.int32), dev)
+                    blocks.append(state_cls(rows, count))
+                self._dev_blocks = blocks
+            elif self._devices:
+                self._dev_rows = jax.device_put(self.rows,
+                                                self._devices[0])
+            else:
+                self._dev_rows = jnp.asarray(self.rows)
+        except Exception:
+            incr_counter("serve", "device_fallback")
+            self._device = False
+            self._dev_rows = None
+            self._dev_blocks = None
+        return self
 
     # -- membership ------------------------------------------------------
     def contains_fps(self, fps: np.ndarray) -> np.ndarray:
@@ -112,7 +172,8 @@ class TableView:
         fps = np.asarray(fps, np.uint32).reshape(n, 4)
         if self._device:
             return self._contains_device(fps)
-        return self._contains_host(fps)
+        with trace.span("serve.contains_host", cat="serve", lanes=n):
+            return self._contains_host(fps)
 
     def _contains_host(self, fps: np.ndarray) -> np.ndarray:
         if self.n_shards == 1:
@@ -142,14 +203,45 @@ class TableView:
         return out
 
     def _contains_device(self, fps: np.ndarray) -> np.ndarray:
+        if self._dev_rows is None and self._dev_blocks is None:
+            # Pinned once per view: queries must never touch the live
+            # (donated-through) table buffer. pin() flips the view to
+            # the host mirror when no device copy can land.
+            self.pin()
+            if not self._device:
+                return self._contains_host(fps)
+        try:
+            with trace.span("serve.contains_device", cat="serve",
+                            lanes=int(fps.shape[0])):
+                return self._contains_device_pinned(fps)
+        except Exception:
+            # A pinned copy that stops answering (device reset, backend
+            # teardown mid-run) degrades to the host mirror instead of
+            # failing the batch; the next epoch retries the device.
+            incr_counter("serve", "device_fallback")
+            self._device = False
+            self._dev_rows = None
+            self._dev_blocks = None
+            return self._contains_host(fps)
+
+    def _contains_device_pinned(self, fps: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp
 
-        if self._dev_rows is None:
-            # Pinned once per view: queries must never touch the live
-            # (donated-through) table buffer.
-            self._dev_rows = jnp.asarray(self.rows)
         n = fps.shape[0]
-        width = 1 << max(0, (n - 1).bit_length())
+        if self._dev_blocks is not None:
+            # Shard-routed: home shard on host (the ingest routing
+            # hash), then the jitted single-table probe against that
+            # shard's pinned block on that shard's device.
+            from ct_mapreduce_tpu.agg.sharded import shard_of_np
+
+            dest = shard_of_np(fps, self.n_shards)
+            out = np.zeros((n,), bool)
+            for s in np.unique(dest):
+                sel = dest == s
+                out[sel] = self._probe_state(self._dev_blocks[s],
+                                             fps[sel])
+            return out
+        width = max(16, 1 << max(0, (n - 1).bit_length()))
         if width != n:
             fps = np.pad(fps, ((0, width - n), (0, 0)))
         keys = jnp.asarray(fps)
@@ -171,6 +263,23 @@ class TableView:
                                      jnp.zeros((), jnp.int32)),
                 keys, max_probes=self.max_probes)
         return np.asarray(found)[:n]
+
+    def _probe_state(self, state, fps: np.ndarray) -> np.ndarray:
+        """Jitted contains against one pinned probe state, pow2-padded
+        (min 16) so compile shapes stay log-bounded — the same rule as
+        the aggregator's `_device_contains`. Keys are placed on the
+        state's device so the kernel never crosses chips."""
+        import jax
+
+        n = fps.shape[0]
+        width = max(16, 1 << max(0, (n - 1).bit_length()))
+        if width != n:
+            fps = np.pad(fps, ((0, width - n), (0, 0)))
+        dev = next(iter(state.rows.devices()), None)
+        keys = jax.device_put(fps, dev)
+        fn = (buckettable.contains if self.layout == "bucket"
+              else hashtable.contains)
+        return np.asarray(fn(state, keys, max_probes=self.max_probes))[:n]
 
     def lookup(self, items: list) -> np.ndarray:
         """Batch membership: ``items`` is a list of
@@ -233,7 +342,8 @@ class TableView:
         }
 
 
-def capture_view(agg, epoch: int, device: bool = False) -> TableView:
+def capture_view(agg, epoch: int, device: bool = False,
+                 devices: Optional[list] = None) -> TableView:
     """Pin one epoch of ``agg`` (TpuAggregator, ShardedAggregator, or
     the host snapshot reader) into an immutable :class:`TableView`.
 
@@ -272,6 +382,7 @@ def capture_view(agg, epoch: int, device: bool = False) -> TableView:
         table_fill=table_fill,
         capacity=getattr(agg, "capacity", rows.shape[0]),
         device=device,
+        devices=devices,
         created_wall=t0,
     )
 
@@ -291,10 +402,21 @@ class SnapshotManager:
         self._lock = threading.Lock()
         self._view: Optional[TableView] = None
         self._epoch = 0
+        self._refreshing = False
+
+    @property
+    def refresh_in_flight(self) -> bool:
+        """True while a capture is running — readers that raced past
+        the staleness check are being served the previous view for the
+        capture's full duration, so staleness can transiently exceed
+        the bound; this flag (surfaced in stats()/healthz) plus the
+        ``serve.snapshot_age_s`` gauge make that window observable."""
+        return self._refreshing
 
     def view(self) -> TableView:
         v = self._view
         if v is not None and v.age_s() <= self.max_staleness_s:
+            set_gauge("serve", "snapshot_age_s", value=v.age_s())
             return v
         with self._lock:
             v = self._view  # a concurrent refresher may have won
@@ -308,10 +430,195 @@ class SnapshotManager:
 
     def _refresh_locked(self) -> TableView:
         self._epoch += 1
-        with trace.span("serve.snapshot", cat="serve", epoch=self._epoch), \
-                measure("serve", "snapshot_capture_s"):
-            v = capture_view(self._agg, self._epoch, device=self._device)
+        self._refreshing = True
+        try:
+            with trace.span("serve.snapshot", cat="serve",
+                            epoch=self._epoch), \
+                    measure("serve", "snapshot_capture_s"):
+                v = capture_view(self._agg, self._epoch,
+                                 device=self._device)
+        finally:
+            self._refreshing = False
         self._view = v
         incr_counter("serve", "snapshot_refresh")
         set_gauge("serve", "snapshot_epoch", value=float(self._epoch))
+        set_gauge("serve", "snapshot_age_s", value=v.age_s())
         return v
+
+    def stats(self) -> dict:
+        v = self._view
+        return {
+            "snapshot_epoch": v.epoch if v else 0,
+            "snapshot_age_s": round(v.age_s(), 6) if v else None,
+            "refresh_in_flight": self._refreshing,
+        }
+
+
+class ReplicaPool:
+    """N epoch-pinned device views serving round-robin with STAGGERED
+    refresh — the query plane's answer to "serve and ingest share a
+    core" (BENCHLOG round 10).
+
+    Every replica is a full, individually consistent :class:`TableView`
+    pinned on device at capture time (``pin()`` runs on the refresh
+    thread, never the serving path). ``view()`` hands out replicas
+    round-robin; when the STALEST replica outlives ``max_staleness_s``
+    (or the pool is not yet full), one background capture swaps that
+    single replica to a fresh epoch — one at a time, so the D2H +
+    fold/table-lock cost of a capture is paid off the serving path and
+    at most one capture contends with ingest at any moment.
+
+    Mixed epochs across replicas are part of the contract, not a race:
+    a batch is answered entirely by one replica, carries that replica's
+    epoch + age, and membership is monotone — an older replica can only
+    under-report within the staleness it surfaces. ``floor_epoch()``
+    (the minimum live epoch) is the validity horizon the hot-serial
+    cache keys against.
+
+    Placement: on a mesh-sharded aggregator each replica pins one
+    per-shard row block per device (``TableView.pin``'s shard-routed
+    mode) so no chip ever holds the full global rows; on one chip the
+    pool holds N full pinned copies. ``device=False`` degrades every
+    replica to the host-numpy mirror (and any pin failure does the
+    same per view, loudly, via ``serve.device_fallback``)."""
+
+    def __init__(self, agg, n_replicas: int = 2,
+                 max_staleness_s: float = 1.0, device: bool = True,
+                 devices: Optional[list] = None) -> None:
+        self._agg = agg
+        self.n_replicas = max(1, int(n_replicas))
+        self.max_staleness_s = float(max_staleness_s)
+        self._device = bool(device)
+        self._devices = devices
+        self._lock = threading.Lock()  # replica list + counters
+        self._refresh_lock = threading.Lock()  # one capture at a time
+        self._replicas: list[TableView] = []
+        self._rr = 0
+        self._epoch = 0
+        self._refreshing = False
+
+    @property
+    def refresh_in_flight(self) -> bool:
+        return self._refreshing
+
+    def _resolve_devices(self) -> Optional[list]:
+        if self._devices is None and self._device:
+            try:
+                import jax
+
+                self._devices = list(jax.devices())
+            except Exception:
+                self._devices = []
+        return self._devices or None
+
+    def _capture(self) -> TableView:
+        with self._lock:
+            self._epoch += 1
+            epoch = self._epoch
+        with trace.span("serve.snapshot", cat="serve", epoch=epoch), \
+                measure("serve", "replica_swap_s"):
+            v = capture_view(self._agg, epoch, device=self._device,
+                             devices=self._resolve_devices())
+            v.pin()  # transfer on THIS thread, not the serving path
+        return v
+
+    def _adopt(self, v: TableView) -> None:
+        with self._lock:
+            if len(self._replicas) < self.n_replicas:
+                v.replica_ix = len(self._replicas)
+                self._replicas.append(v)
+            else:
+                stale = min(range(len(self._replicas)),
+                            key=lambda i: self._replicas[i].epoch)
+                v.replica_ix = stale
+                self._replicas[stale] = v
+            n = len(self._replicas)
+        incr_counter("serve", "replica_refresh")
+        set_gauge("serve", "replicas", value=float(n))
+        set_gauge("serve", "snapshot_epoch", value=float(v.epoch))
+
+    def _refresh_holding_lock(self) -> TableView:
+        self._refreshing = True
+        try:
+            v = self._capture()
+            self._adopt(v)
+            return v
+        finally:
+            self._refreshing = False
+
+    def refresh(self) -> TableView:
+        """Force one staggered swap NOW (synchronous): capture + pin a
+        new epoch and replace the stalest replica (or fill an empty
+        pool slot). Serving continues on the other replicas meanwhile."""
+        with self._refresh_lock:
+            return self._refresh_holding_lock()
+
+    def warm(self) -> "ReplicaPool":
+        """Fill every pool slot synchronously (bench/sweep setup, so
+        the timed window never includes a capture)."""
+        while True:
+            with self._lock:
+                if len(self._replicas) >= self.n_replicas:
+                    return self
+            self.refresh()
+
+    def view(self) -> TableView:
+        """One replica, round-robin; triggers a background staggered
+        swap when the stalest replica is past the staleness bound. Only
+        the very first call (empty pool) captures synchronously."""
+        with self._lock:
+            reps = list(self._replicas)
+            if reps:
+                self._rr = (self._rr + 1) % len(reps)
+                v = reps[self._rr]
+        if not reps:
+            with self._refresh_lock:
+                with self._lock:
+                    if self._replicas:  # lost the first-capture race
+                        return self._replicas[0]
+                return self._refresh_holding_lock()
+        due = (len(reps) < self.n_replicas
+               or max(r.age_s() for r in reps) > self.max_staleness_s)
+        if due and not self._refreshing:
+            self._refresh_async()
+        set_gauge("serve", "snapshot_age_s", value=v.age_s())
+        return v
+
+    def _refresh_async(self) -> None:
+        if not self._refresh_lock.acquire(blocking=False):
+            return  # a capture is already in flight
+        self._refreshing = True
+
+        def run() -> None:
+            try:
+                v = self._capture()
+                self._adopt(v)
+            finally:
+                self._refreshing = False
+                self._refresh_lock.release()
+
+        threading.Thread(target=run, name="serve-replica-refresh",
+                         daemon=True).start()
+
+    def floor_epoch(self) -> int:
+        """Minimum epoch across live replicas — the oldest answer the
+        round-robin could legally serve, and the hot-serial cache's
+        validity horizon."""
+        with self._lock:
+            return min((r.epoch for r in self._replicas), default=0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            reps = list(self._replicas)
+            refreshing = self._refreshing
+        ages = [round(r.age_s(), 6) for r in reps]
+        return {
+            "replicas": len(reps),
+            "replica_target": self.n_replicas,
+            "replica_epochs": [r.epoch for r in reps],
+            "replica_ages_s": ages,
+            "replica_device": [bool(r._device) for r in reps],
+            "snapshot_epoch": max((r.epoch for r in reps), default=0),
+            "snapshot_age_s": min(ages) if ages else None,
+            "refresh_in_flight": refreshing,
+        }

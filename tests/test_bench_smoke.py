@@ -65,6 +65,20 @@ def test_bench_smoke_overlap_gate(monkeypatch):
     assert out["smoke_serve_lanes_per_s"] > 0
     assert 0 < out["smoke_serve_wait_p50_ms"] <= out["smoke_serve_wait_p99_ms"]
     assert out["smoke_serve_shed"] > 0
+    # Serve-device leg (ISSUE 7): run_smoke gates exact parity under
+    # concurrent ingest on the replicated device tier; here we pin the
+    # structural numbers — the jitted device contains really executed
+    # (span-counted), >=2 replicas answered batches round-robin, the
+    # hot-serial cache served hits on the zipf-ish mix, and misses
+    # still coalesced into multi-lane batches.
+    assert out["smoke_serve_dev_parity"] == 1
+    assert out["smoke_serve_dev_replicas"] >= 2
+    assert out["smoke_serve_dev_lookups"] > 0
+    assert out["smoke_serve_dev_contains_spans"] > 0
+    assert out["smoke_serve_dev_cache_hits"] > 0
+    assert 0 < out["smoke_serve_dev_cache_hit_rate"] <= 1
+    assert out["smoke_serve_dev_mean_batch_lanes"] > 1.0
+    assert out["smoke_serve_dev_fallbacks"] == 0
     # Pre-parsed leg: run_smoke itself asserts exact parity with the
     # walker lanes AND that D2H flag traffic stays O(flagged); here we
     # only pin that the leg ran when the native extractor exists (its

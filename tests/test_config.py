@@ -181,3 +181,35 @@ def test_query_port_directive(tmp_path):
     assert cfg3.query_port == 9090
     assert CTConfig.load(argv=[], env={}).query_port == 0  # default off
     assert "queryPort" in CTConfig().usage()
+
+
+def test_serve_tier_directives(tmp_path):
+    """serveReplicas / serveDevice / serveCacheSize (ISSUE 7): ini +
+    env layering, bool/int parse, defaults, usage()."""
+    ini = tmp_path / "ct.ini"
+    ini.write_text(
+        "serveReplicas = 4\nserveDevice = false\nserveCacheSize = 512\n")
+    cfg = CTConfig.load(argv=["--config", str(ini)], env={})
+    assert cfg.serve_replicas == 4
+    assert cfg.serve_device is False
+    assert cfg.serve_cache_size == 512
+    cfg2 = CTConfig.load(
+        argv=["--config", str(ini)],
+        env={"serveReplicas": "8", "serveDevice": "true",
+             "serveCacheSize": "-1"})
+    assert cfg2.serve_replicas == 8
+    assert cfg2.serve_device is True
+    assert cfg2.serve_cache_size == -1
+    # Unparseable env falls back to the file value.
+    cfg3 = CTConfig.load(argv=["--config", str(ini)],
+                         env={"serveReplicas": "many"})
+    assert cfg3.serve_replicas == 4
+    # Defaults: pool/cache auto-sized downstream (resolve_serve),
+    # device serving on.
+    dflt = CTConfig.load(argv=[], env={})
+    assert dflt.serve_replicas == 0
+    assert dflt.serve_device is True
+    assert dflt.serve_cache_size == 0
+    usage = CTConfig().usage()
+    for d in ("serveReplicas", "serveDevice", "serveCacheSize"):
+        assert d in usage

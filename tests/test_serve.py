@@ -25,8 +25,17 @@ from ct_mapreduce_tpu.serve.batcher import (
     MicroBatcher,
     Overloaded,
 )
-from ct_mapreduce_tpu.serve.server import MembershipOracle, QueryServer
-from ct_mapreduce_tpu.serve.snapshot import SnapshotManager, capture_view
+from ct_mapreduce_tpu.serve.cache import HotSerialCache
+from ct_mapreduce_tpu.serve.server import (
+    MembershipOracle,
+    QueryServer,
+    resolve_serve,
+)
+from ct_mapreduce_tpu.serve.snapshot import (
+    ReplicaPool,
+    SnapshotManager,
+    capture_view,
+)
 from ct_mapreduce_tpu.utils import syncerts
 
 
@@ -304,10 +313,15 @@ def test_concurrent_ingest_query_consistency(template):
     """Ingest and query race for real: a writer thread feeds batches
     through a growing table (capacity starts at 1<<10 so grow-and-
     rehash fires mid-run) while reader threads query through a
-    MembershipOracle with a tight staleness bound. Contract: a serial
-    acked more than (staleness bound + capture slack) before the query
-    was submitted MUST read known; a serial never fed must NEVER read
-    known."""
+    MembershipOracle with a tight staleness bound. Contract (round 12,
+    epoch-honest): every answer surfaces its view's age, and a serial
+    acked before that view's capture MUST read known — the replica
+    pool's staggered refresh means serving never blocks on a capture
+    (a mid-grow capture can take seconds while it waits on the fold
+    lock), so staleness is surfaced rather than wall-clock-capped. A
+    serial never fed must NEVER read known, at any epoch, and
+    refreshes must actually keep landing (some answers fresh within
+    the bound)."""
     agg = TpuAggregator(capacity=1 << 10, batch_size=64,
                         max_capacity=1 << 14, grow_at=0.55)
     issuer_idx = agg.registry.get_or_assign(template.issuer_der)
@@ -315,6 +329,8 @@ def test_concurrent_ingest_query_consistency(template):
     stale = 0.05
     oracle = MembershipOracle(agg, max_batch=256, max_delay_s=0.002,
                               max_staleness_s=stale)
+    fresh_ages: list[float] = []
+    epoch_walls: dict[int, float] = {}  # epoch -> capture-start wall
     acked: dict[int, float] = {}
     acked_lock = threading.Lock()
     stop = threading.Event()
@@ -350,22 +366,32 @@ def test_concurrent_ingest_query_consistency(template):
             js = list(known_now)
             pick = [js[int(r.integers(len(js)))] for _ in range(4)]
             ghosts = [int(r.integers(10**6, 2 * 10**6)) for _ in range(2)]
-            t_q = time.time()
             items = [(issuer_idx, eh, _serial_bytes(template, j))
                      for j in pick + ghosts]
             try:
                 res = oracle.query_raw(items)
             except Overloaded:
                 continue
-            for (known, _epoch, _age), j in zip(res, pick + ghosts):
+            # The authoritative capture instants: created_wall is
+            # anchored at capture START (before the fold-lock wait),
+            # so "acked before it" under-approximates "acked before
+            # the lock was held" — the direction that keeps the check
+            # sound under multi-second mid-grow captures.
+            for rep in list(oracle.snapshots._replicas):
+                epoch_walls[rep.epoch] = rep.created_wall
+            for (known, epoch, age), j in zip(res, pick + ghosts):
                 if j in known_now:
-                    # Acked long before the query ⇒ must be visible.
-                    if not known and known_now[j] < t_q - stale - 0.25:
+                    wall = epoch_walls.get(epoch)
+                    if not known and wall is not None \
+                            and known_now[j] < wall - 0.05:
                         errors.append(
-                            f"acked serial {j} invisible "
-                            f"({t_q - known_now[j]:.3f}s after ack)")
+                            f"acked serial {j} invisible in epoch "
+                            f"{epoch}, captured "
+                            f"{wall - known_now[j]:.3f}s after its ack")
                 elif known:
                     errors.append(f"false positive: ghost serial {j}")
+                if not stop.is_set():
+                    fresh_ages.append(age)  # GIL-atomic append
             if stop.is_set():
                 break
 
@@ -382,6 +408,14 @@ def test_concurrent_ingest_query_consistency(template):
     assert agg.metrics.get("overflow", 0) >= 0  # table survived
     # The run really exercised growth (the mid-grow torn-read hazard).
     assert agg.capacity > 1 << 10, "table never grew; raise n_batches"
+    # Liveness: staggered refresh kept landing — the pool advanced
+    # through multiple epochs under load instead of serving one
+    # ancient view forever (ages are compile-inflated on a cold CPU
+    # run, so the structural check is the robust one).
+    assert fresh_ages, "no answers recorded"
+    pool_stats = oracle.snapshots.stats()
+    assert pool_stats["snapshot_epoch"] >= 3, pool_stats
+    assert pool_stats["replicas"] >= 2, pool_stats
     # And the final state is complete: every fed serial present.
     final = capture_view(agg, epoch=99)
     items = [(issuer_idx, eh, _serial_bytes(template, j))
@@ -562,3 +596,299 @@ def test_serve_batch_spans_recorded(template):
         assert len(waits) == 8
     finally:
         trace.disable()
+
+
+# -- replica pool (round 12) ----------------------------------------------
+
+
+def test_replica_pool_mixed_epoch_parity_fuzz(template):
+    """N replicas at MIXED epochs through table growth must agree with
+    the serial truth set: on every replica, every serial acked before
+    that replica's capture reads known, and ghosts read absent at
+    every epoch (the ISSUE 7 parity-fuzz acceptance)."""
+    agg = TpuAggregator(capacity=1 << 10, batch_size=64,
+                        max_capacity=1 << 14, grow_at=0.55)
+    issuer_idx = agg.registry.get_or_assign(template.issuer_der)
+    _, eh = _identity(template)
+    pool = ReplicaPool(agg, n_replicas=3, max_staleness_s=1e9,
+                       device=True)
+    rng = np.random.default_rng(7)
+    acked = 0
+    truth_at_capture: dict[int, int] = {}
+    for _stage in range(6):  # 576 lanes through a 1<<10 table ⇒ grows
+        agg.ingest([
+            (syncerts.stamp_serial(template, acked + i),
+             template.issuer_der)
+            for i in range(96)
+        ])
+        acked += 96
+        v = pool.refresh()  # staggered: swaps exactly ONE replica
+        truth_at_capture[v.epoch] = acked
+    assert agg.capacity > 1 << 10, "table never grew"
+    reps = list(pool._replicas)
+    assert len(reps) == 3
+    assert len({r.epoch for r in reps}) == 3, "epochs not mixed"
+    for r in reps:
+        n_known = truth_at_capture[r.epoch]
+        pick = [int(j) for j in rng.integers(0, acked, size=48)]
+        ghosts = [int(j) for j in rng.integers(10**6, 2 * 10**6, size=16)]
+        items = [(issuer_idx, eh, _serial_bytes(template, j))
+                 for j in pick + ghosts]
+        got = r.lookup(items)
+        for k, j in enumerate(pick):
+            if j < n_known:
+                assert got[k], (
+                    f"epoch {r.epoch}: serial {j} acked before capture "
+                    f"(truth {n_known}) reads absent")
+        assert not got[len(pick):].any(), f"ghost hit at epoch {r.epoch}"
+    # Round-robin serving rotates through every live replica.
+    served = {pool.view().epoch for _ in range(9)}
+    assert served == {r.epoch for r in reps}
+    # floor_epoch is the oldest live epoch (the cache validity horizon).
+    assert pool.floor_epoch() == min(r.epoch for r in reps)
+
+
+def test_replica_pool_shard_routed_block_pinning(template):
+    """On a multi-device mesh the pool pins per-shard row blocks, each
+    on its shard's own device — never the full global rows on one chip
+    — and the shard-routed probe answers with exact parity."""
+    import jax
+    from jax.sharding import Mesh
+
+    from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
+
+    mesh = Mesh(np.array(jax.devices()), ("shard",))
+    agg = ShardedAggregator(mesh, capacity=1 << 12, batch_size=64)
+    agg.ingest([(syncerts.stamp_serial(template, j), template.issuer_der)
+                for j in range(64)])
+    issuer_id, eh = _identity(template)
+    idx = agg.registry.index_of_issuer_id(issuer_id)
+    pool = ReplicaPool(agg, n_replicas=2, max_staleness_s=1e9,
+                       device=True).warm()
+    devs = jax.devices()
+    for v in pool._replicas:
+        assert v.n_shards == mesh.devices.size
+        assert v._dev_blocks is not None, "replica pinned no blocks"
+        assert v._dev_rows is None, "replica pinned the full global rows"
+        block = v.rows.shape[0] // v.n_shards
+        for s, state in enumerate(v._dev_blocks):
+            assert state.rows.shape[0] == block
+            assert list(state.rows.devices()) == [devs[s % len(devs)]]
+    items = [(idx, eh, _serial_bytes(template, j)) for j in range(80)]
+    for v in pool._replicas:
+        got = v.lookup(items)
+        assert got[:64].all() and not got[64:].any()
+        # Device parity against the pure-host routed mirror.
+        host = capture_view(agg, epoch=99).lookup(items)
+        assert np.array_equal(got, host)
+
+
+def test_view_device_fallback_to_host(template, monkeypatch):
+    """A view that cannot pin a device copy degrades to the host
+    mirror (serve.device_fallback) instead of failing the batch."""
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
+    agg = TpuAggregator(capacity=1 << 12, batch_size=64)
+    agg.ingest([(syncerts.stamp_serial(template, j), template.issuer_der)
+                for j in range(10)])
+    issuer_id, eh = _identity(template)
+    idx = agg.registry.index_of_issuer_id(issuer_id)
+    sink = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink)
+    try:
+        view = capture_view(agg, epoch=1, device=True)
+        import jax.numpy as jnp
+        monkeypatch.setattr(jnp, "asarray", lambda *a, **k: (
+            (_ for _ in ()).throw(RuntimeError("no device"))))
+        items = [(idx, eh, _serial_bytes(template, j)) for j in range(12)]
+        got = view.lookup(items)
+        assert got[:10].all() and not got[10:].any()
+        assert view._device is False  # latched to the host path
+        counters = sink.snapshot()["counters"]
+        assert counters.get("serve.device_fallback", 0) >= 1
+        # Subsequent lookups answer from the host mirror directly.
+        assert view.lookup(items[:3]).all()
+    finally:
+        tmetrics.set_sink(prev)
+
+
+# -- hot-serial cache ------------------------------------------------------
+
+
+def test_hot_serial_cache_unit():
+    """Epoch-floor validity + LRU bound + no answer downgrades."""
+    c = HotSerialCache(capacity=2)
+    c.put(("a",), known=False, epoch=1, created_wall=0.0)
+    assert c.get(("a",), floor_epoch=1).known is False  # hit after miss
+    # Floor bump (every replica refreshed past epoch 1) ⇒ the entry is
+    # unreachable and evicted on probe — no ghost answers across epochs.
+    assert c.get(("a",), floor_epoch=2) is None
+    assert c.get(("a",), floor_epoch=1) is None
+    c.put(("a",), True, 3, 0.0)
+    c.put(("b",), True, 3, 0.0)
+    c.put(("c",), True, 3, 0.0)
+    assert len(c) == 2  # LRU bound holds
+    assert c.get(("a",), 3) is None  # oldest evicted
+    c.put(("b",), False, 2, 0.0)  # older epoch must not downgrade
+    assert c.get(("b",), 2).known is True
+    disabled = HotSerialCache(capacity=0)
+    disabled.put(("x",), True, 1, 0.0)
+    assert disabled.get(("x",), 1) is None and len(disabled) == 0
+
+
+def test_oracle_cache_hit_and_epoch_invalidation(template):
+    """Through the oracle: a miss fills the cache, the repeat hits it
+    (same answer, no new batch), and once every replica refreshes past
+    the cached epoch a formerly-absent serial reads known — the stale
+    False cannot ghost across epochs."""
+    agg = TpuAggregator(capacity=1 << 12, batch_size=64)
+    agg.ingest([(syncerts.stamp_serial(template, j), template.issuer_der)
+                for j in range(20)])
+    issuer_id, eh = _identity(template)
+    idx = agg.registry.index_of_issuer_id(issuer_id)
+    oracle = MembershipOracle(agg, max_batch=64, max_delay_s=0.001,
+                              max_staleness_s=1e9, replicas=2,
+                              cache_size=128)
+    try:
+        present = (idx, eh, _serial_bytes(template, 3))
+        ghost = (idx, eh, _serial_bytes(template, 999))
+        r1 = oracle.query_raw([present, ghost])
+        assert r1[0][0] is True and r1[1][0] is False
+        assert len(oracle.cache) == 2
+        batches_before = oracle.snapshots.stats()  # noqa: F841
+        hits0, misses0 = oracle.cache.hits, oracle.cache.misses
+        r2 = oracle.query_raw([present, ghost])
+        assert oracle.cache.hits == hits0 + 2  # pure cache round
+        assert oracle.cache.misses == misses0
+        assert r2[0][0] is True and r2[1][0] is False
+        assert r2[0][1] <= r1[0][1] + 1  # epoch surfaced, not invented
+        # Ingest the ghost, then refresh EVERY replica past the cached
+        # epoch: the stale False must be invalidated by construction.
+        agg.ingest([(syncerts.stamp_serial(template, 999),
+                     template.issuer_der)])
+        for _ in range(oracle.snapshots.n_replicas):
+            oracle.snapshots.refresh()
+        r3 = oracle.query_raw([ghost])
+        assert r3[0][0] is True, "stale cached False ghosted across epochs"
+    finally:
+        oracle.close()
+
+
+# -- oversized-bulk split --------------------------------------------------
+
+
+def test_bulk_split_oversized_submit_under_ingest(template):
+    """A bulk larger than max_batch splits into max_batch-sized
+    sub-requests (serve.split_requests), reassembled in order with
+    exact parity — while ingest keeps feeding the table."""
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+    from ct_mapreduce_tpu.telemetry import trace
+
+    agg = TpuAggregator(capacity=1 << 12, batch_size=64)
+    agg.ingest([(syncerts.stamp_serial(template, j), template.issuer_der)
+                for j in range(40)])
+    issuer_id, eh = _identity(template)
+    idx = agg.registry.index_of_issuer_id(issuer_id)
+    sink = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink)
+    tracer = trace.enable()
+    t0 = tracer.now_us()
+    oracle = MembershipOracle(agg, max_batch=16, max_delay_s=0.001,
+                              max_staleness_s=0.05, cache_size=-1)
+    stop = threading.Event()
+
+    def bg_ingest():
+        j0 = 2000
+        while not stop.is_set() and j0 < 2600:
+            agg.ingest([(syncerts.stamp_serial(template, j),
+                         template.issuer_der)
+                        for j in range(j0, j0 + 64)])
+            j0 += 64
+
+    bg = threading.Thread(target=bg_ingest)
+    bg.start()
+    try:
+        # 40 present + 20 absent = 60 lanes through a 16-lane cap.
+        items = [(idx, eh, _serial_bytes(template, j)) for j in range(40)]
+        items += [(idx, eh, _serial_bytes(template, j))
+                  for j in range(5000, 5020)]
+        for _ in range(3):
+            res = oracle.query_raw(items)
+            assert [r[0] for r in res] == [True] * 40 + [False] * 20
+    finally:
+        stop.set()
+        bg.join()
+        oracle.close()
+        trace.disable()
+        tmetrics.set_sink(prev)
+    counters = sink.snapshot()["counters"]
+    assert counters.get("serve.split_requests", 0) >= 3
+    spans = [e for e in tracer.events()
+             if e.get("ph") == "X" and e["name"] == "serve.batch"
+             and e["ts"] >= t0]
+    assert spans and all(e["args"]["lanes"] <= 16 for e in spans), \
+        "an executed batch exceeded max_batch"
+
+
+# -- staleness observability (refresh_in_flight / snapshot_age_s) ---------
+
+
+def test_refresh_in_flight_and_age_surfaced(template, monkeypatch):
+    from ct_mapreduce_tpu.serve import snapshot as snapmod
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
+    agg = TpuAggregator(capacity=1 << 12, batch_size=64)
+    sink = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink)
+    try:
+        mgr = SnapshotManager(agg, max_staleness_s=1000.0)
+        assert mgr.refresh_in_flight is False
+        seen = {}
+        orig = snapmod.capture_view
+
+        def spying_capture(a, epoch, device=False, devices=None):
+            seen["in_flight"] = mgr.refresh_in_flight
+            return orig(a, epoch, device=device, devices=devices)
+
+        monkeypatch.setattr(snapmod, "capture_view", spying_capture)
+        mgr.refresh()
+        monkeypatch.setattr(snapmod, "capture_view", orig)
+        assert seen["in_flight"] is True  # flag held across the capture
+        assert mgr.refresh_in_flight is False
+        st = mgr.stats()
+        assert st["refresh_in_flight"] is False
+        assert st["snapshot_epoch"] == 1 and st["snapshot_age_s"] >= 0
+        mgr.view()
+        gauges = sink.snapshot()["gauges"]
+        assert "serve.snapshot_age_s" in gauges
+        # The pool surfaces the same observability per replica set.
+        pool = ReplicaPool(agg, n_replicas=2, max_staleness_s=1e9,
+                           device=False).warm()
+        pst = pool.stats()
+        assert pst["refresh_in_flight"] is False
+        assert pst["replicas"] == 2 and len(pst["replica_epochs"]) == 2
+        assert pst["snapshot_age_s"] is not None
+    finally:
+        tmetrics.set_sink(prev)
+
+
+def test_resolve_serve_layering(monkeypatch):
+    """explicit > CTMR_SERVE_* env > defaults, unparseable ignored."""
+    for k in ("CTMR_SERVE_REPLICAS", "CTMR_SERVE_DEVICE",
+              "CTMR_SERVE_CACHE_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    assert resolve_serve() == (2, True, 4096)
+    assert resolve_serve(replicas=5, device=False, cache_size=64) == \
+        (5, False, 64)
+    assert resolve_serve(cache_size=-1)[2] == 0  # -1 disables
+    monkeypatch.setenv("CTMR_SERVE_REPLICAS", "7")
+    monkeypatch.setenv("CTMR_SERVE_DEVICE", "0")
+    monkeypatch.setenv("CTMR_SERVE_CACHE_SIZE", "99")
+    assert resolve_serve() == (7, False, 99)
+    assert resolve_serve(replicas=3, device=True, cache_size=16) == \
+        (3, True, 16)  # explicit beats env
+    monkeypatch.setenv("CTMR_SERVE_REPLICAS", "banana")
+    assert resolve_serve()[0] == 2  # unparseable env ignored

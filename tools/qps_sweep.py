@@ -1,21 +1,37 @@
-"""qps_sweep: the query plane's batch-size × max-delay frontier.
+"""qps_sweep: the query plane's serving frontier, closed- and open-loop.
 
-Maps the dynamic-batching tradeoff of serve/batcher.py the way an
-inference-serving team tunes a model server: for each
-(max_batch, max_delay) point, closed-loop client threads hammer a
-MembershipOracle over a pre-filled dedup table and we record achieved
-QPS, client p50/p99 latency, mean lanes per executed batch, and the
-shed rate. Small max_delay buys latency at the cost of batch
-amortization; large max_batch only pays off once concurrency can fill
-it — the frontier says which knee to run at.
+Two modes, one pre-filled dedup table:
+
+- **Closed-loop** (default; the round-10 shape): N client threads
+  hammer a MembershipOracle back-to-back per (max_batch, max_delay)
+  point. Measures the batching tradeoff the way an inference team
+  tunes a model server, but the arrival process is throttled by the
+  clients' own latency — it can never show overload.
+- **Open-loop** (``--open-loop``; the round-12 shape): arrivals land
+  at a FIXED offered rate regardless of completion, the way real
+  traffic arrives. Dispatcher threads pull a precomputed arrival
+  schedule; a request's latency is measured from its *scheduled*
+  arrival instant, so backlog shows up as latency (and, past the
+  admission bound, as explicit shed) instead of silently throttling
+  the load generator. Sweeping offered rates maps achieved QPS,
+  p50/p99, and the shed fraction — where the plane saturates, not
+  just how fast a closed loop spins.
+
+The serving tier under test is the round-12 stack: snapshot replica
+pool (``--replicas``), device `contains` by default (``--host`` forces
+the round-10 numpy mirror), hot-serial cache (``--cache``; -1
+disables), with ``--zipf`` skewing the probe mix the way membership
+traffic actually looks (a hot working set, not uniform keys).
 
 Usage:
     python tools/qps_sweep.py [--entries 200000] [--threads 8]
-        [--duration 0.5] [--batches 16,64,256,1024]
-        [--delays-ms 0.5,2,5] [--json]
+        [--duration 0.5] [--batches 16,64,256,1024] [--delays-ms 0.5,2,5]
+    python tools/qps_sweep.py --open-loop --rates 2000,10000,50000
+        [--arrival-batch 16] [--zipf 1.2] [--replicas 2] [--cache 4096]
+        [--host] [--json]
 
 CPU-friendly (JAX_PLATFORMS=cpu works); on a TPU host the same sweep
-measures the device `contains` path via --device.
+measures the pinned-device `contains` path at real widths.
 """
 
 from __future__ import annotations
@@ -63,21 +79,50 @@ def serial_bytes(j: int) -> bytes:
     return b"\x00" * 8 + int(j).to_bytes(8, "big")
 
 
+def make_oracle(agg, eh: int, entries: int, max_batch: int,
+                max_delay_s: float, device: bool, replicas: int,
+                cache_size: int, max_queue_lanes: int = 0):
+    from ct_mapreduce_tpu.serve.server import MembershipOracle
+
+    oracle = MembershipOracle(
+        agg, max_batch=max_batch, max_delay_s=max_delay_s,
+        max_queue_lanes=max_queue_lanes or max(4 * max_batch, 1024),
+        max_staleness_s=60.0, device=device, replicas=replicas,
+        cache_size=cache_size if cache_size != 0 else -1)
+    oracle.snapshots.warm()  # captures + pins outside the timed window
+    # Warm the contains kernel at every pow2 width the batcher can
+    # form: compiles are per-shape and must not bill the timed window.
+    # Probe keys sit outside [0, 2*entries) so they never alias the
+    # sweep's probe domain through the cache.
+    w = 16
+    while w <= max_batch:
+        oracle.query_raw([(0, eh, serial_bytes(2 * entries + k))
+                          for k in range(w)])
+        w *= 2
+    return oracle
+
+
+def probe_indices(rng, n: int, entries: int, zipf: float) -> np.ndarray:
+    """Probe mix over [0, 2*entries): uniform (zipf=0 — half present,
+    half absent) or zipf-skewed ranks (a hot working set, the traffic
+    shape the hot-serial cache exists for)."""
+    if zipf <= 0:
+        return rng.integers(0, 2 * entries, size=n)
+    return np.minimum(rng.zipf(zipf, size=n) - 1, 2 * entries - 1)
+
+
 def run_point(agg, eh: int, entries: int, max_batch: int,
               max_delay_s: float, threads: int, duration_s: float,
-              device: bool) -> dict:
+              device: bool, replicas: int = 1,
+              cache_size: int = -1) -> dict:
     from ct_mapreduce_tpu.serve.batcher import Overloaded
-    from ct_mapreduce_tpu.serve.server import MembershipOracle
     from ct_mapreduce_tpu.telemetry import metrics as tmetrics
 
     sink = tmetrics.InMemSink()
     prev = tmetrics.get_sink()
     tmetrics.set_sink(sink)
-    oracle = MembershipOracle(
-        agg, max_batch=max_batch, max_delay_s=max_delay_s,
-        max_queue_lanes=max(4 * max_batch, 1024),
-        max_staleness_s=60.0, device=device)
-    oracle.snapshots.refresh()  # capture outside the timed window
+    oracle = make_oracle(agg, eh, entries, max_batch, max_delay_s,
+                         device, replicas, cache_size)
     lat: list[float] = []
     shed = [0]
     stop = time.perf_counter() + duration_s
@@ -122,6 +167,92 @@ def run_point(agg, eh: int, entries: int, max_batch: int,
     }
 
 
+def run_open_loop(agg, eh: int, entries: int, rate: float,
+                  duration_s: float, arrival_batch: int, threads: int,
+                  max_batch: int, max_delay_s: float, device: bool,
+                  replicas: int, cache_size: int, zipf: float) -> dict:
+    """One offered-rate point: arrivals of ``arrival_batch`` lanes land
+    every ``arrival_batch / rate`` seconds on a fixed schedule;
+    latency is measured from the SCHEDULED instant, so dispatcher
+    backlog is latency, not hidden throttling."""
+    from ct_mapreduce_tpu.serve.batcher import Overloaded
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
+    sink = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink)
+    oracle = make_oracle(agg, eh, entries, max_batch, max_delay_s,
+                         device, replicas, cache_size,
+                         max_queue_lanes=max(8 * max_batch, 4096))
+    interval = arrival_batch / rate
+    n_arrivals = max(1, int(duration_s / interval))
+    rng = np.random.default_rng(42)
+    sched = probe_indices(rng, n_arrivals * arrival_batch, entries,
+                          zipf).reshape(n_arrivals, arrival_batch)
+    lat: list[float] = []
+    shed_lanes = [0]
+    errors: list[str] = []
+    next_ix = [0]
+    ix_lock = threading.Lock()
+    t_start = time.perf_counter() + 0.05  # let every worker reach the gate
+
+    def worker() -> None:
+        while True:
+            with ix_lock:
+                i = next_ix[0]
+                next_ix[0] += 1
+            if i >= n_arrivals:
+                return
+            t_i = t_start + i * interval
+            now = time.perf_counter()
+            if now < t_i:
+                time.sleep(t_i - now)
+            js = sched[i]
+            items = [(0, eh, serial_bytes(int(j))) for j in js]
+            try:
+                res = oracle.query_raw(items)
+            except Overloaded:
+                shed_lanes.append(arrival_batch)
+                continue
+            lat.append(time.perf_counter() - t_i)  # GIL-atomic append
+            for r, j in zip(res, js):
+                if r[0] != (j < entries):
+                    errors.append(f"parity broke at {j}")
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = max(time.perf_counter() - t_start, 1e-9)
+    oracle.close()
+    tmetrics.set_sink(prev)
+    if errors:
+        raise SystemExit(f"open-loop parity: {errors[:3]}")
+    snap = sink.snapshot()
+    counters = snap["counters"]
+    lanes = counters.get("serve.lanes", 0.0)
+    batches = counters.get("serve.batches", 0.0)
+    hits = counters.get("serve.cache_hit", 0.0)
+    misses = counters.get("serve.cache_miss", 0.0)
+    done = len(lat) * arrival_batch
+    offered = n_arrivals * arrival_batch
+    lat.sort()
+    n = len(lat)
+    return {
+        "offered_qps": round(rate, 1),
+        "achieved_qps": round(done / wall, 1),
+        "p50_ms": round(lat[n // 2] * 1e3, 3) if n else None,
+        "p99_ms": (round(lat[min(n - 1, int(0.99 * n))] * 1e3, 3)
+                   if n else None),
+        "shed_frac": round(sum(shed_lanes) / offered, 4),
+        "mean_batch_lanes": round(lanes / batches, 2) if batches else 0.0,
+        "cache_hit_rate": (round(hits / (hits + misses), 4)
+                           if hits + misses else 0.0),
+        "lanes_done": done,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--entries", type=int, default=200_000)
@@ -132,30 +263,65 @@ def main() -> int:
     ap.add_argument("--batches", default="16,64,256,1024")
     ap.add_argument("--delays-ms", default="0.5,2,5")
     ap.add_argument("--device", action="store_true",
-                    help="serve from a pinned device copy (jitted "
-                    "contains) instead of the host mirror")
+                    help="force device serving (pinned replicas + "
+                    "jitted contains) — this is the default")
+    ap.add_argument("--host", action="store_true",
+                    help="force the round-10 host-numpy mirror")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="snapshot replicas in the serving pool")
+    ap.add_argument("--cache", type=int, default=4096,
+                    help="hot-serial cache entries (-1 disables)")
+    ap.add_argument("--open-loop", action="store_true",
+                    help="fixed-arrival-rate mode (see --rates)")
+    ap.add_argument("--rates", default="2000,5000,10000,20000,50000",
+                    help="open-loop offered rates, lanes/s")
+    ap.add_argument("--arrival-batch", type=int, default=16,
+                    help="lanes per scheduled arrival (bulk size)")
+    ap.add_argument("--zipf", type=float, default=0.0,
+                    help="zipf skew for the probe mix (0 = uniform "
+                    "over 2x entries; 1.2 is a realistic hot set)")
+    ap.add_argument("--max-batch", type=int, default=1024,
+                    help="open-loop oracle max_batch")
+    ap.add_argument("--max-delay-ms", type=float, default=1.0,
+                    help="open-loop oracle max_delay")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
+    device = not args.host  # device by default, exactly like the plane
     agg, eh = build_aggregator(args.entries, args.table_bits)
+    mode = "open-loop" if args.open_loop else "closed-loop"
     print(f"# table: {args.entries} entries in 2^{args.table_bits} slots, "
-          f"{args.threads} closed-loop threads, "
-          f"{args.duration}s/point, "
-          f"{'device' if args.device else 'host'} contains",
-          file=sys.stderr)
+          f"{args.threads} {mode} threads, "
+          f"{'device' if device else 'host'} contains, "
+          f"{args.replicas} replicas, cache {args.cache}, "
+          f"zipf {args.zipf}", file=sys.stderr)
     rows = []
-    for mb in (int(x) for x in args.batches.split(",")):
-        for dly in (float(x) for x in args.delays_ms.split(",")):
-            r = run_point(agg, eh, args.entries, mb, dly / 1e3,
-                          args.threads, args.duration, args.device)
+    if args.open_loop:
+        for rate in (float(x) for x in args.rates.split(",")):
+            r = run_open_loop(
+                agg, eh, args.entries, rate, args.duration,
+                args.arrival_batch, args.threads, args.max_batch,
+                args.max_delay_ms / 1e3, device, args.replicas,
+                args.cache, args.zipf)
             rows.append(r)
             print(f"# {r}", file=sys.stderr)
+        hdr = ("offered_qps", "achieved_qps", "p50_ms", "p99_ms",
+               "shed_frac", "mean_batch_lanes", "cache_hit_rate")
+    else:
+        for mb in (int(x) for x in args.batches.split(",")):
+            for dly in (float(x) for x in args.delays_ms.split(",")):
+                r = run_point(agg, eh, args.entries, mb, dly / 1e3,
+                              args.threads, args.duration, device,
+                              replicas=args.replicas,
+                              cache_size=args.cache)
+                rows.append(r)
+                print(f"# {r}", file=sys.stderr)
+        hdr = ("max_batch", "max_delay_ms", "qps", "p50_ms", "p99_ms",
+               "mean_batch_lanes", "shed")
     if args.json:
         json.dump(rows, sys.stdout, indent=2)
         print()
     else:
-        hdr = ("max_batch", "max_delay_ms", "qps", "p50_ms", "p99_ms",
-               "mean_batch_lanes", "shed")
         print("\t".join(hdr))
         for r in rows:
             print("\t".join(str(r[h]) for h in hdr))
