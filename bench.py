@@ -1736,6 +1736,161 @@ def run_smoke() -> dict:
     }
 
 
+def run_verify_smoke() -> dict:
+    """CT_BENCH_SMOKE verify leg (round 13): the signature-
+    verification lane under the staged device queue, CPU-only.
+
+    A mixed corpus — P-256 SCTs (valid and corrupted), P-384 and RSA
+    SCTs (host-fallback lanes), SCT-less certs, and unknown-log SCTs —
+    replays through the SAME AggregatorSink machinery with
+    ``verifySignatures`` on and ``chunksPerDispatch`` 2, and enforces:
+
+      (1) verdict parity EXACT: per-outcome totals equal the truth
+          recomputed independently per lane with the pure-python
+          reference verifier;
+      (2) the device kernel really ran and batched: span-counted
+          ``device.verify`` executions with mean lanes/execution > 1;
+      (3) the fallback lane count equals the undecidable-lane count
+          (every lane the extractor or key registry routed around the
+          device kernel — none silently dropped, none double-judged).
+
+    Device batches pad to width 32 (the tier-1 parity suite's compiled
+    width, so one process compiles the ladder once).
+    """
+    import base64
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.ingest import leaf as leaflib
+    from ct_mapreduce_tpu.ingest.sync import AggregatorSink, RawBatch
+    from ct_mapreduce_tpu.telemetry import trace as ttrace
+    from ct_mapreduce_tpu.utils import minicert
+    from ct_mapreduce_tpu.verify import host as vhost
+    from ct_mapreduce_tpu.verify import sct as sctlib
+
+    owns_trace = not ttrace.enabled()
+    if owns_trace:
+        ttrace.enable(os.path.join(
+            tempfile.mkdtemp(prefix="ctmr-verify-smoke-"),
+            "verify_smoke_trace.json"))
+    events_before = len(ttrace.snapshot_events())
+
+    import datetime as _dt
+
+    future = _dt.datetime(2031, 6, 15, tzinfo=_dt.timezone.utc)
+    issuer = minicert.make_cert(serial=1, issuer_cn="Smoke Verify CA",
+                                is_ca=True, not_after=future)
+    p256 = sctlib.EcSctSigner("smoke-a")
+    p384 = sctlib.EcSctSigner("smoke-b", vhost.P384)
+    rsa = sctlib.RsaSctSigner()
+    unknown = sctlib.EcSctSigner("smoke-unknown")
+
+    n = 54
+    pairs = []
+    truth = {"verified": 0, "failed": 0, "no_sct": 0, "no_key": 0,
+             "device": 0, "fallback": 0}
+    for s in range(n):
+        base = minicert.make_cert(
+            serial=5000 + s, issuer_cn="Smoke Verify CA",
+            subject_cn=f"sv{s}", is_ca=False, not_after=future)
+        kind = s % 9
+        if kind in (0, 1, 2, 3):
+            der = sctlib.attach_sct(base, p256, 10**12 + s,
+                                    corrupt_signature=(kind == 3))
+            truth["device"] += 1
+            truth["verified" if kind != 3 else "failed"] += 1
+        elif kind == 4:
+            der = sctlib.attach_sct(base, p384, 10**12 + s)
+            truth["fallback"] += 1
+            truth["verified"] += 1
+        elif kind == 5:
+            der = sctlib.attach_sct(base, rsa, 10**12 + s,
+                                    corrupt_signature=True)
+            truth["fallback"] += 1
+            truth["failed"] += 1
+        elif kind in (6, 7):
+            der = base
+            truth["no_sct"] += 1
+        else:
+            der = sctlib.attach_sct(base, unknown, 10**12 + s)
+            truth["no_key"] += 1
+        pairs.append(der)
+
+    lis = [base64.b64encode(leaflib.encode_leaf_input(
+        d, timestamp_ms=1_700_000_000_000 + j)).decode()
+        for j, d in enumerate(pairs)]
+    eds = [base64.b64encode(
+        leaflib.encode_extra_data([issuer])).decode()] * n
+
+    t0 = time.monotonic()
+    agg = TpuAggregator(capacity=1 << 12, batch_size=32)
+    sink = AggregatorSink(agg, flush_size=32, device_queue_depth=0,
+                          verify_signatures=True,
+                          chunks_per_dispatch=2)
+    sink.verifier.batch_width = 32
+    for signer in (p256, p384, rsa):
+        sink.verifier.keys.register_signer(signer)
+    sink.store_raw_batch(RawBatch(lis, eds, 0, "verify-smoke-log"))
+    sink.flush()
+    wall = time.monotonic() - t0
+
+    st = dict(sink.verifier.stats)
+    for k_truth, k_stat in (("verified", "verified"),
+                            ("failed", "failed"),
+                            ("no_sct", "no_sct"),
+                            ("no_key", "no_key"),
+                            ("device", "device_lanes"),
+                            ("fallback", "host_lanes")):
+        if st[k_stat] != truth[k_truth]:
+            raise BenchError(
+                f"verify smoke parity: {k_stat}={st[k_stat]} != "
+                f"truth {k_truth}={truth[k_truth]} ({st} vs {truth})")
+
+    events = ttrace.snapshot_events()[events_before:]
+    vspans = [e for e in events
+              if e.get("name") == "device.verify" and e.get("ph") == "X"]
+    span_lanes = sum(int(e.get("args", {}).get("lanes", 0))
+                     for e in vspans)
+    if not vspans or span_lanes != truth["device"]:
+        raise BenchError(
+            f"verify smoke spans: {len(vspans)} device.verify spans "
+            f"covering {span_lanes} lanes != {truth['device']}")
+    mean_lanes = span_lanes / len(vspans)
+    if mean_lanes <= 1.0:
+        raise BenchError(
+            f"verify smoke batching: mean lanes/execution {mean_lanes}")
+    per_issuer = agg.verify_counts()
+    if (sum(v for v, _ in per_issuer.values()) != truth["verified"]
+            or sum(f for _, f in per_issuer.values()) != truth["failed"]):
+        raise BenchError(f"verify smoke per-issuer fold: {per_issuer}")
+    if owns_trace:
+        ttrace.disable()
+
+    log(f"verify smoke: {n} lanes in {wall:.2f}s — "
+        f"{truth['device']} device / {truth['fallback']} fallback / "
+        f"{truth['no_sct']} no-sct / {truth['no_key']} no-key; "
+        f"{len(vspans)} device execs, {mean_lanes:.1f} lanes/exec")
+    return {
+        "metric": "ct_verify_smoke",
+        "value": n / max(wall, 1e-9),
+        "unit": "entries/s",
+        "smoke_verify_lanes": n,
+        "smoke_verify_verified": st["verified"],
+        "smoke_verify_failed": st["failed"],
+        "smoke_verify_device_lanes": st["device_lanes"],
+        "smoke_verify_fallback_lanes": st["host_lanes"],
+        "smoke_verify_no_sct": st["no_sct"],
+        "smoke_verify_no_key": st["no_key"],
+        "smoke_verify_device_execs": len(vspans),
+        "smoke_verify_mean_batch_lanes": mean_lanes,
+        "smoke_verify_wall_s": wall,
+    }
+
+
 def smoke_main() -> int:
     try:
         payload = run_smoke()

@@ -359,6 +359,11 @@ class AggregateSnapshot:
     crls: dict[str, set[str]]  # issuerID → CRL DP URLs
     dns: dict[str, set[str]]  # issuerID → issuer DN strings
     total: int = 0
+    # Signature-verification outcomes (round 13): per-issuer embedded-
+    # SCT verdict counts. Empty when verifySignatures is off — every
+    # pre-round-13 consumer sees byte-identical reports.
+    verified: dict[str, int] = field(default_factory=dict)
+    failed: dict[str, int] = field(default_factory=dict)
 
     def issuers(self) -> list[str]:
         out = {iss for iss, _ in self.counts}
@@ -503,6 +508,11 @@ class TpuAggregator:
         self._dn_raw_seen: set[tuple[int, bytes]] = set()
         # Device-side per-issuer unknown totals (running).
         self.issuer_totals = np.zeros((packing.MAX_ISSUERS,), np.int64)
+        # Per-issuer embedded-SCT verdict counts (round 13), fed by the
+        # verify lane (verify/lane.py) under the fold lock; all-zero
+        # (and absent from reports) unless verifySignatures is on.
+        self.verify_verified = np.zeros((packing.MAX_ISSUERS,), np.int64)
+        self.verify_failed = np.zeros((packing.MAX_ISSUERS,), np.int64)
         # Submitted-but-not-completed pipelined ingests (FIFO).
         self._outstanding: list[PendingIngest] = []
         # False until the first device-step submit: lets the host lane
@@ -728,6 +738,33 @@ class TpuAggregator:
     def _now_hour(self) -> int:
         now = self._fixed_now or datetime.now(timezone.utc)
         return int(now.timestamp()) // 3600
+
+    def grow_verify_totals(self, max_idx: int) -> None:
+        """Ensure the verify vectors cover issuer index ``max_idx``
+        (registry indices are unbounded; only the device meta word caps
+        at MAX_ISSUERS — same policy as the issuer_totals growth in
+        ``_host_dedup``). Caller holds the fold lock."""
+        if max_idx < self.verify_verified.shape[0]:
+            return
+        size = max(max_idx + 1, 2 * self.verify_verified.shape[0])
+        for name in ("verify_verified", "verify_failed"):
+            grown = np.zeros((size,), np.int64)
+            old = getattr(self, name)
+            grown[: old.shape[0]] = old
+            setattr(self, name, grown)
+
+    def verify_counts(self) -> dict[str, tuple[int, int]]:
+        """issuerID → (verified, failed), nonzero rows only."""
+        out: dict[str, tuple[int, int]] = {}
+        nz = np.nonzero(self.verify_verified | self.verify_failed)[0]
+        for i in nz:
+            i = int(i)
+            if i < len(self.registry):
+                out[self.registry.issuer_at(i).id()] = (
+                    int(self.verify_verified[i]),
+                    int(self.verify_failed[i]),
+                )
+        return out
 
     # -- ingest ----------------------------------------------------------
     def ingest(self, entries: list[tuple[bytes, bytes]]) -> IngestResult:
@@ -1611,8 +1648,11 @@ class TpuAggregator:
         dns = {
             self.registry.issuer_at(i).id(): set(s) for i, s in self.dn_sets.items()
         }
+        vc = self.verify_counts()
         return AggregateSnapshot(
-            counts=counts, crls=crls, dns=dns, total=sum(counts.values())
+            counts=counts, crls=crls, dns=dns, total=sum(counts.values()),
+            verified={k: v for k, (v, _) in vc.items() if v},
+            failed={k: f for k, (_, f) in vc.items() if f},
         )
 
     def _count_key(self, issuer_idx: int, exp_hour: int) -> tuple[str, str]:
@@ -1688,6 +1728,8 @@ class TpuAggregator:
             ),
             base_hour=np.int64(self.base_hour),
             issuer_totals=self.issuer_totals,
+            verify_verified=self.verify_verified,
+            verify_failed=self.verify_failed,
             host_keys=np.array(
                 [(i, e) for i, e, _ in host_items], dtype=np.int64
             ).reshape(-1, 2),
@@ -1777,6 +1819,11 @@ class TpuAggregator:
         self.base_hour = int(z["base_hour"])
         self.registry = IssuerRegistry.from_json(z["registry"].tobytes().decode())
         self.issuer_totals = z["issuer_totals"].copy()
+        # Verify vectors are absent in pre-round-13 snapshots → zeros.
+        for name in ("verify_verified", "verify_failed"):
+            setattr(self, name,
+                    z[name].copy() if name in z
+                    else np.zeros((packing.MAX_ISSUERS,), np.int64))
         self.host_serials = {}
         for (idx, eh), blob in zip(z["host_keys"], z["host_vals"]):
             serials = {

@@ -103,6 +103,10 @@ def build_sink(config: CTConfig, database, backend=None):
                               preparsed=config.preparsed_ingest or None,
                               chunks_per_dispatch=config.chunks_per_dispatch,
                               staging_depth=config.staging_depth,
+                              verify_signatures=(config.verify_signatures
+                                                 or None),
+                              verify_log_keys=(config.verify_log_keys
+                                               or None),
                               ), model
     sink = DatabaseSink(
         database,

@@ -137,14 +137,22 @@ def report_from_tpu_snapshot(config: CTConfig, out, verbosity: int = 0) -> int:
                                       else pem.decode())
                         except Exception as err:
                             print(f"error: {err}", file=out)
+        tail = ""
+        if iss in snap.verified or iss in snap.failed:
+            tail = (f", {snap.verified.get(iss, 0)} scts verified, "
+                    f"{snap.failed.get(iss, 0)} scts failed")
         print(
             f" --> {len(dates)} hours, {issuer_serials} serials known, "
-            f"{len(crls)} crls known, {len(dns)} issuerDNs known",
+            f"{len(crls)} crls known, {len(dns)} issuerDNs known{tail}",
             file=out,
         )
+    verify_tail = ""
+    if snap.verified or snap.failed:
+        verify_tail = (f", {sum(snap.verified.values())} scts verified, "
+                       f"{sum(snap.failed.values())} scts failed")
     print(
         f"overall totals: {len(snap.issuers())} issuers, "
-        f"{total_serials} serials, {total_crls} crls",
+        f"{total_serials} serials, {total_crls} crls{verify_tail}",
         file=out,
     )
     # Per-log checkpoint states print in TPU mode too: ct-fetch
@@ -215,21 +223,32 @@ def collect_tpu_report(config: CTConfig) -> Optional[dict]:
         n = sum(dates.values())
         total_serials += n
         total_crls += len(crls)
-        issuers.append({
+        row = {
             "id": iss,
             "dns": dns,
             "crls": crls,
             "serials": n,
             "expDates": {exp: dates[exp] for exp in sorted(dates)},
-        })
+        }
+        if iss in snap.verified or iss in snap.failed:
+            row["sctsVerified"] = snap.verified.get(iss, 0)
+            row["sctsFailed"] = snap.failed.get(iss, 0)
+        issuers.append(row)
     database, _cache, _backend = get_configured_storage(config)
+    totals = {
+        "issuers": len(issuers),
+        "serials": total_serials,
+        "crls": total_crls,
+    }
+    if snap.verified or snap.failed:
+        # Verify totals appear only when the lane ran — pre-round-13
+        # consumers (and verifySignatures=off runs) see the exact same
+        # document, keeping the text/JSON parity pin byte-stable.
+        totals["sctsVerified"] = sum(snap.verified.values())
+        totals["sctsFailed"] = sum(snap.failed.values())
     return {
         "issuers": issuers,
-        "totals": {
-            "issuers": len(issuers),
-            "serials": total_serials,
-            "crls": total_crls,
-        },
+        "totals": totals,
         "logStatus": _log_status_lines(config, database),
     }
 

@@ -80,6 +80,11 @@ class CTConfig:
     # copies (jitted contains); host-numpy fallback when no copy pins
     serve_cache_size: int = 0  # hot-serial result cache entries
     # (0 = CTMR_SERVE_CACHE_SIZE env, then 4096; -1 disables)
+    verify_signatures: bool = False  # batched on-device SCT/ECDSA
+    # verification lane (CTMR_VERIFY=1 equivalent; tpu backend only)
+    verify_log_keys: str = ""  # JSON file of trusted log keys for the
+    # verify lane (CTMR_VERIFY_KEYS equivalent; empty = no keys →
+    # every SCT counts as verify.no_key)
     verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
@@ -124,6 +129,8 @@ class CTConfig:
         "serveReplicas": ("serve_replicas", int),
         "serveDevice": ("serve_device", bool),
         "serveCacheSize": ("serve_cache_size", int),
+        "verifySignatures": ("verify_signatures", bool),
+        "verifyLogKeys": ("verify_log_keys", str),
     }
 
     @classmethod
@@ -295,6 +302,12 @@ class CTConfig:
             "serveCacheSize = hot-serial result cache entries in front "
             "of the batcher (0 = CTMR_SERVE_CACHE_SIZE, then 4096; "
             "-1 disables)",
+            "verifySignatures = batched on-device SCT/ECDSA-P256 "
+            "verification lane with pure-python host fallback "
+            "(CTMR_VERIFY equivalent; per-issuer verified/failed "
+            "counts in reports and /issuer)",
+            "verifyLogKeys = JSON file of trusted CT log keys for the "
+            "verify lane (CTMR_VERIFY_KEYS equivalent)",
         ]
         return "\n".join(lines)
 

@@ -75,6 +75,21 @@ def resolve_staging(chunks_per_dispatch: int = 0,
     return max(1, k), max(1, d if d > 0 else 2)
 
 
+def _resolve_verify_lazy(flag, keys_path):
+    """Import-light wrapper around ``verify.lane.resolve_verify`` —
+    the verify package (and with it the ECDSA kernels) only loads when
+    the lane could actually be on."""
+    import os
+
+    if flag is None:
+        flag = os.environ.get("CTMR_VERIFY", "0") == "1"
+    if not flag:
+        return False, "", 0
+    from ct_mapreduce_tpu.verify.lane import resolve_verify
+
+    return resolve_verify(True, keys_path)
+
+
 class EntrySink(Protocol):
     def store(self, entry: DecodedEntry, log_url: str) -> None: ...
     def flush(self) -> None: ...
@@ -156,7 +171,9 @@ class AggregatorSink:
                  device_queue_depth: int = 2, decode_workers: int = 0,
                  overlap_workers: int = 0, preparsed: Optional[bool] = None,
                  decode_threads: int = 0, chunks_per_dispatch: int = 0,
-                 staging_depth: int = 0):
+                 staging_depth: int = 0,
+                 verify_signatures: Optional[bool] = None,
+                 verify_log_keys: Optional[str] = None):
         self.aggregator = aggregator
         self.flush_size = flush_size
         # Optional durable backend (certPath): first-seen certs get the
@@ -228,6 +245,28 @@ class AggregatorSink:
         self._staging: list[_PreparedChunk] = []  # the ring (FIFO)
         self._staging_hw = 0  # high-water occupancy
         self._staging_bufs: dict[tuple, tuple] = {}  # (K,B,L) → (bufs, idx)
+        # Signature-verification lane (round 13): `verifySignatures`
+        # directive / CTMR_VERIFY env. Each decoded chunk additionally
+        # runs the native SCT extraction pass; P-256-keyed SCTs batch
+        # onto the device ECDSA kernel (ops/ecdsa.py) alongside the
+        # dedup dispatch, undecidable lanes replay through the pure-
+        # python host verifier — the walker-fallback pattern applied
+        # to verification. Verdicts fold into the aggregator's per-
+        # issuer verified/failed vectors. Off by default: the lane adds
+        # an extraction pass + a second kernel family to the hot path.
+        v_on, v_keys, v_batch = _resolve_verify_lazy(
+            verify_signatures, verify_log_keys)
+        self.verifier = None
+        if v_on:
+            from ct_mapreduce_tpu.verify.lane import (
+                LogKeyRegistry,
+                SignatureVerifier,
+            )
+
+            keys = (LogKeyRegistry.from_json_file(v_keys) if v_keys
+                    else LogKeyRegistry())
+            self.verifier = SignatureVerifier(
+                aggregator, keys, batch_width=v_batch)
         self.overlap_workers = max(0, int(overlap_workers))
         self._overlap = None
         if self.overlap_workers:
@@ -427,6 +466,24 @@ class AggregatorSink:
             else:
                 oversized.append((e.cert_der, e.issuer_der))
 
+        # Signature-verification lane: one more native pass over the
+        # packed rows extracts embedded-SCT tuples. Runs on the decode
+        # stage (overlap-friendly); classification and dispatch happen
+        # at submit time under the dispatch lock. The eligible set is
+        # the decoded-OK + issuer-mapped lanes BEFORE the sidecar
+        # split below — walker-fallback lanes still carry auditable
+        # SCTs. (Oversized certs never reach packed rows; their rare
+        # SCTs are not audited — an honest gap, counted nowhere.)
+        scts = None
+        verify_eligible = None
+        if self.verifier is not None:
+            from ct_mapreduce_tpu.native import leafpack as _lp
+
+            scts = _lp.extract_scts(
+                data, dec.length,
+                threads=self.decode_threads or self.decode_workers)
+            verify_eligible = valid.copy()
+
         # Pre-parsed lane: extract walker-exact sidecars on the host
         # (one more native pass over the just-packed rows — cache-warm)
         # and split undecidable lanes out for the device-walker replay.
@@ -476,6 +533,18 @@ class AggregatorSink:
             issuer_idx=issuer_idx, valid=valid, dec=dec,
             oversized=oversized, sidecar=sidecar,
             walker_fallback=walker_fallback,
+            scts=scts, verify_eligible=verify_eligible,
+        )
+
+    def _submit_verify(self, prep: "_PreparedChunk") -> None:
+        """Route one prepared chunk's SCT lanes into the verify lane.
+        Caller holds ``_dispatch_lock`` (the verifier shares the one
+        device stream with the dedup dispatch)."""
+        if self.verifier is None or prep.scts is None:
+            return
+        self.verifier.submit_chunk(
+            prep.scts, prep.issuer_idx, prep.verify_eligible,
+            prep.host_data, prep.length,
         )
 
     # -- staged device queue (round 11) ----------------------------------
@@ -629,6 +698,7 @@ class AggregatorSink:
         the staging ring (``_submit_staged``): a chunk may return no
         drain items (staged, awaiting ring mates) or one pending
         covering a whole K-chunk envelope."""
+        self._submit_verify(prep)
         if self.chunks_per_dispatch > 1 and prep.sidecar is None:
             return self._submit_staged(prep)
         items: list[tuple] = []
@@ -719,6 +789,10 @@ class AggregatorSink:
                     else:
                         self._store_pems(item[1], item[2])
                 self._drain_inflight(0)
+                if self.verifier is not None:
+                    # Barrier for the verify lane too: the partial
+                    # device batch dispatches and every verdict folds.
+                    self.verifier.drain()
 
     def close(self) -> None:
         """Flush, then stop the overlap scheduler's threads (no-op in
@@ -804,6 +878,9 @@ class _PreparedChunk:
     sidecar: object = None  # leafpack.Sidecar — pre-parsed lane active
     walker_fallback: list = field(default_factory=list)  # sidecar-
     # undecidable lanes, replayed through the device-walker path
+    scts: object = None  # verify.sct.SctBatch — verify lane active
+    verify_eligible: object = None  # bool[n] — decoded-OK lanes as of
+    # extraction time (pre sidecar-split)
 
 
 @dataclass

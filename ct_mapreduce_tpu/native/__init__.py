@@ -155,6 +155,27 @@ def load() -> Optional[ctypes.CDLL]:
             lib.has_mt = True
         except AttributeError:
             lib.has_mt = False
+        # SCT extraction (round 13). Same stale-library contract as
+        # has_mt: a cached .so from before the verify lane loads fine,
+        # callers check `has_sct` and use the python extractor.
+        try:
+            lib.ctmr_extract_scts.restype = None
+            lib.ctmr_extract_scts.argtypes = [
+                ctypes.c_int64,
+                u8p, ctypes.c_int64, i32p,
+                u8p,
+                u8p, u8p,
+                i64p,
+                u8p, u8p,
+                u8p, u8p,
+            ]
+            lib.ctmr_extract_scts_mt.restype = None
+            lib.ctmr_extract_scts_mt.argtypes = (
+                lib.ctmr_extract_scts.argtypes + [ctypes.c_int64]
+            )
+            lib.has_sct = True
+        except AttributeError:
+            lib.has_sct = False
         _LIB = lib
         return _LIB
 

@@ -181,6 +181,55 @@ def _extract_sidecars(data: np.ndarray, length: np.ndarray,
     )
 
 
+def extract_scts(data: np.ndarray, length: np.ndarray,
+                 threads: Optional[int] = None):
+    """Embedded-SCT tuples for packed rows: a
+    :class:`ct_mapreduce_tpu.verify.sct.SctBatch` — the host half of
+    the signature-verification lane (status / convention digest /
+    log id / r / s per lane). Native scanner when available
+    (``ctmr_extract_scts``, lane-range threaded like the sidecar
+    pass), else the bit-identical pure-python mirror — unlike the
+    sidecar extractor there IS a python fallback, because the verify
+    lane has no device walker to fall back onto."""
+    from ct_mapreduce_tpu.verify.sct import SctBatch, extract_scts_np
+
+    with trace.span("native.extract_scts", cat="native",
+                    entries=int(data.shape[0])):
+        import os
+
+        lib = (None if os.environ.get("CTMR_NATIVE", "1") == "0"
+               else load_native())
+        if lib is None or not getattr(lib, "has_sct", False):
+            return extract_scts_np(data, length)
+        n = int(data.shape[0])
+        data = np.ascontiguousarray(data, np.uint8)
+        length = np.ascontiguousarray(length, np.int32)
+        out = SctBatch.empty(n)
+        if n == 0:
+            return out
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        t = resolve_threads(n, threads)
+        fn, extra = lib.ctmr_extract_scts, ()
+        if t > 1 and getattr(lib, "has_mt", False):
+            fn, extra = lib.ctmr_extract_scts_mt, (t,)
+        fn(
+            n, data.ctypes.data_as(u8p), data.shape[1],
+            length.ctypes.data_as(i32p),
+            out.ok.ctypes.data_as(u8p),
+            out.digest.ctypes.data_as(u8p),
+            out.log_id.ctypes.data_as(u8p),
+            out.timestamp_ms.ctypes.data_as(i64p),
+            out.r.ctypes.data_as(u8p),
+            out.s.ctypes.data_as(u8p),
+            out.hash_alg.ctypes.data_as(u8p),
+            out.sig_alg.ctypes.data_as(u8p),
+            *extra,
+        )
+        return out
+
+
 def _assign_gid(gid_of: dict, group_issuers: list, der: bytes) -> int:
     """Accumulating DER→group-id assignment (shared by every producer
     that merges issuer groups)."""

@@ -1,0 +1,286 @@
+"""Batched 256-bit modular arithmetic on int32-limb lanes.
+
+The signature-verification lane (ops/ecdsa.py) needs field arithmetic
+over the P-256 prime and group order, vectorized over a batch axis the
+same way the SHA-256 kernel vectorizes lanes (ops/sha256.py): every
+lane is an independent big integer, all uint32 lane arithmetic, no
+cross-lane traffic — the shape the FPGA ECDSA engine (arxiv
+2112.02229) and zkSpeed's big-integer datapath (arxiv 2504.06211)
+exploit with wide limb lanes.
+
+Representation: a 256-bit value is ``uint32[..., 16]`` — sixteen
+16-bit limbs, little-endian. 16-bit limbs are the widest radix whose
+products and carry chains close over uint32 without 64-bit temporaries
+(accelerator int ops are 32-bit): a limb product is < 2^32, and the
+column accumulators below stay < 2^23.
+
+Multiplication is Montgomery (REDC) with lazy column accumulation:
+the schoolbook product accumulates split lo/hi half-products into 33
+columns (each column sums ≤ 64 values < 2^16 — no overflow), then the
+reduction walks the 16 low limbs in a ``fori_loop``, deferring the
+m·N half-products into the same lazy columns, with one carry
+normalization at the end.
+
+Graph-size discipline: the ECDSA kernel runs ~20 of these per
+double-and-add step inside a 256-iteration ``fori_loop``, so the
+traced cost of ONE multiply bounds XLA compile time for the whole
+verifier. Everything sequential over limbs is therefore a
+``lax.scan``/``fori_loop`` (carry chains, borrow chains, REDC — one
+traced iteration each) and the schoolbook columns are pad-and-add
+(flat, fusible) rather than scatter updates; a fully unrolled
+formulation compiled ~200 s on CPU, this one ~seconds.
+
+Moduli are host-side constants (:class:`Mod`); the two instances the
+verifier uses (P-256 field ``P256_P`` and order ``P256_N``) are built
+at import. All functions are shape-polymorphic over leading batch
+dims and jit-safe.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NLIMB = 16  # 16 x 16-bit limbs = 256 bits
+RADIX = 16
+MASK = np.uint32(0xFFFF)
+
+
+def limbs_from_int(v: int) -> np.ndarray:
+    """Python int → uint32[16] little-endian 16-bit limbs."""
+    return np.array(
+        [(v >> (RADIX * k)) & 0xFFFF for k in range(NLIMB)], np.uint32
+    )
+
+
+def int_from_limbs(a: np.ndarray) -> int:
+    """uint32[..., 16] limbs → python int (host-side, tests/debug)."""
+    a = np.asarray(a)
+    return sum(int(a[..., k]) << (RADIX * k) for k in range(NLIMB))
+
+
+@dataclass(frozen=True)
+class Mod:
+    """One modulus' Montgomery constants (host numpy, baked at trace)."""
+
+    n: np.ndarray  # uint32[16] — the modulus
+    n0p: np.uint32  # -n^-1 mod 2^16 (REDC quotient multiplier)
+    r2: np.ndarray  # uint32[16] — R^2 mod n (R = 2^256): to-Montgomery
+    one: np.ndarray  # uint32[16] — plain 1 (from-Montgomery multiplier)
+    one_m: np.ndarray  # uint32[16] — R mod n (Montgomery 1)
+    exp_inv_bits: np.ndarray  # uint32[256] — bits of n-2, MSB first
+    # (Fermat inversion exponent; n must be prime)
+
+    @classmethod
+    def make(cls, n_int: int) -> "Mod":
+        r = 1 << 256
+        n0p = (-pow(n_int, -1, 1 << RADIX)) % (1 << RADIX)
+        e = n_int - 2
+        bits = np.array(
+            [(e >> (255 - i)) & 1 for i in range(256)], np.uint32
+        )
+        return cls(
+            n=limbs_from_int(n_int),
+            n0p=np.uint32(n0p),
+            r2=limbs_from_int(r * r % n_int),
+            one=limbs_from_int(1),
+            one_m=limbs_from_int(r % n_int),
+            exp_inv_bits=bits,
+        )
+
+
+# The two moduli of the P-256 verifier.
+P256_P_INT = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+P256_N_INT = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+
+P256_P = Mod.make(P256_P_INT)
+P256_N = Mod.make(P256_N_INT)
+
+
+def bytes_to_limbs(b):
+    """uint8[..., 32] big-endian bytes → uint32[..., 16] limbs."""
+    b = b.astype(jnp.uint32)
+    return jnp.stack(
+        [(b[..., 30 - 2 * k] << 8) | b[..., 31 - 2 * k]
+         for k in range(NLIMB)],
+        axis=-1,
+    )
+
+
+def is_zero(a) -> jnp.ndarray:
+    """bool[...]: a == 0."""
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a, b) -> jnp.ndarray:
+    """bool[...]: a == b limbwise."""
+    return jnp.all(a == b, axis=-1)
+
+
+def _carry_norm(a, n_out: int):
+    """Propagate carries over ``a`` (uint32[..., k], limbs < 2^31) into
+    ``n_out`` normalized 16-bit limbs plus the final carry word. One
+    traced iteration (lax.scan over the limb axis)."""
+    k = a.shape[-1]
+    if k < n_out:
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, n_out - k)])
+    xs = jnp.moveaxis(a[..., :n_out], -1, 0)
+
+    def step(c, x):
+        s = x + c
+        return s >> RADIX, s & MASK
+
+    c, out = jax.lax.scan(step, jnp.zeros(a.shape[:-1], jnp.uint32), xs)
+    # Residual columns past n_out sit at the carry's own position and
+    # fold into it (mont_mul's column 2·NLIMB is always zero, but the
+    # math stays total for any caller).
+    for j in range(n_out, k):
+        c = c + a[..., j]
+    return jnp.moveaxis(out, 0, -1), c
+
+
+def sub_raw(a, b):
+    """(a - b) mod 2^256 with the final borrow: (limbs, borrow[...])."""
+    xs = (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0))
+    base = jnp.uint32(1 << RADIX)
+
+    def step(borrow, ab):
+        ak, bk = ab
+        x = ak + base - bk - borrow
+        return jnp.uint32(1) - (x >> RADIX), x & MASK
+
+    borrow, out = jax.lax.scan(
+        step, jnp.zeros(a.shape[:-1], jnp.uint32), xs
+    )
+    return jnp.moveaxis(out, 0, -1), borrow
+
+
+def geq(a, b) -> jnp.ndarray:
+    """bool[...]: a >= b."""
+    _, borrow = sub_raw(a, b)
+    return borrow == 0
+
+
+def _cond_sub_n(a, carry, mod: Mod):
+    """a (< 2n, possibly with a 2^256 carry bit) → canonical a mod n."""
+    n = jnp.broadcast_to(jnp.asarray(mod.n), a.shape)
+    sub, borrow = sub_raw(a, n)
+    take = (carry != 0) | (borrow == 0)
+    return jnp.where(take[..., None], sub, a)
+
+
+def add_mod(a, b, mod: Mod):
+    """(a + b) mod n for canonical a, b < n."""
+    s, c = _carry_norm(a + b, NLIMB)
+    return _cond_sub_n(s, c, mod)
+
+
+def sub_mod(a, b, mod: Mod):
+    """(a - b) mod n for canonical a, b < n."""
+    d, borrow = sub_raw(a, b)
+    dn, _ = _carry_norm(d + jnp.asarray(mod.n), NLIMB)
+    return jnp.where((borrow != 0)[..., None], dn, d)
+
+
+def mod_reduce_once(a, mod: Mod):
+    """a mod n for a < 2n (one conditional subtract) — enough for a
+    256-bit SHA digest against the P-256 order, and for x_R mod n
+    (P-256: p < 2n)."""
+    zero = jnp.zeros(a.shape[:-1], jnp.uint32)
+    return _cond_sub_n(a, zero, mod)
+
+
+def mont_mul(a, b, mod: Mod):
+    """Montgomery product a·b·R^-1 mod n (R = 2^256), canonical result.
+
+    Preconditions: b < n; a < R (any 16-limb value — the to-Montgomery
+    conversion feeds raw 256-bit digests through here against r2 < n).
+
+    Bound sketch: schoolbook columns take ≤ 16 lo + 16 hi terms
+    (< 2^21); REDC adds ≤ 1 lo + 1 hi per outer step (< 2^22 total);
+    the running REDC carry stays < 2^7 — everything closes over
+    uint32. The REDC output is < 2n, canonicalized by one conditional
+    subtract.
+    """
+    shape = a.shape[:-1]
+    pads = [(0, 0)] * len(shape)
+    # Schoolbook columns: outer product split into half-words, rows
+    # shifted into place with static pads (flat, fusible — no scatter).
+    prod = a[..., :, None] * b[..., None, :]  # [..., 16, 16]
+    lo = prod & MASK
+    hi = prod >> RADIX
+    t = jnp.zeros(shape + (2 * NLIMB + 1,), jnp.uint32)
+    for i in range(NLIMB):
+        t = t + jnp.pad(lo[..., i, :], pads + [(i, NLIMB + 1 - i)])
+        t = t + jnp.pad(hi[..., i, :], pads + [(i + 1, NLIMB - i)])
+
+    # REDC: finalize the 16 low limbs in order; position i's true low
+    # 16 bits are known once the carry from position i-1 arrives, the
+    # m·N half-products for higher positions stay lazy in the columns.
+    n = jnp.asarray(mod.n)
+    axis = t.ndim - 1
+
+    def body(i, carry_t):
+        carry, t = carry_t
+        ti = jax.lax.dynamic_index_in_dim(t, i, axis, keepdims=False)
+        ti = ti + carry
+        m = (ti * mod.n0p) & MASK
+        p = m[..., None] * n  # [..., 16]
+        x = ti + (p[..., 0] & MASK)  # ≡ 0 mod 2^16 by choice of m
+        # Deferred adds for positions i+1..i+16: element j of the
+        # window gains lo(p[j+1]) (j < 15) and hi(p[j]).
+        upd = jnp.pad(p[..., 1:] & MASK, pads + [(0, 1)]) + (p >> RADIX)
+        win = jax.lax.dynamic_slice_in_dim(t, i + 1, NLIMB, axis)
+        t = jax.lax.dynamic_update_slice_in_dim(
+            t, win + upd, i + 1, axis
+        )
+        return x >> RADIX, t
+
+    carry, t = jax.lax.fori_loop(
+        0, NLIMB, body, (jnp.zeros(shape, jnp.uint32), t)
+    )
+    res, c = _carry_norm(t[..., NLIMB:].at[..., 0].add(carry), NLIMB)
+    return _cond_sub_n(res, c, mod)
+
+
+def to_mont(a, mod: Mod):
+    """a → a·R mod n (a any 16-limb value < R)."""
+    return mont_mul(a, jnp.asarray(mod.r2), mod)
+
+
+def from_mont(a_m, mod: Mod):
+    """a·R → a mod n."""
+    return mont_mul(a_m, jnp.asarray(mod.one), mod)
+
+
+def mont_sqr(a, mod: Mod):
+    return mont_mul(a, a, mod)
+
+
+def mont_inv(a_m, mod: Mod):
+    """Montgomery-domain inverse via Fermat: a^(n-2) (n prime).
+
+    Square-and-multiply over the fixed exponent bits with a
+    ``fori_loop`` (one squaring + one masked multiply per iteration),
+    so the traced graph is one step, not 256. a_m == 0 → 0 (the ECDSA
+    caller masks those lanes out via its own validity flags)."""
+    bits = jnp.asarray(mod.exp_inv_bits)
+    acc0 = jnp.broadcast_to(jnp.asarray(mod.one_m), a_m.shape)
+
+    def body(i, acc):
+        acc = mont_sqr(acc, mod)
+        mul = mont_mul(acc, a_m, mod)
+        return jnp.where((bits[i] != 0)[..., None], mul, acc)
+
+    return jax.lax.fori_loop(0, 256, body, acc0)
+
+
+def bit_at(a, k):
+    """Bit ``k`` (traced scalar) of a limb value: uint32[...] ∈ {0,1}."""
+    limb = jax.lax.dynamic_index_in_dim(
+        a, k >> 4, axis=a.ndim - 1, keepdims=False
+    )
+    return (limb >> (k & 15).astype(jnp.uint32)) & 1

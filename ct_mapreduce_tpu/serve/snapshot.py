@@ -90,6 +90,7 @@ class TableView:
         device: bool = False,
         devices: Optional[list] = None,
         created_wall: Optional[float] = None,
+        verify_counts: Optional[dict] = None,
     ) -> None:
         self.epoch = epoch
         self.rows = rows
@@ -104,6 +105,9 @@ class TableView:
         self.registry = registry
         self.table_fill = table_fill
         self.capacity = capacity
+        # issuerID → (verified, failed) embedded-SCT verdicts as of
+        # this epoch (round 13); empty when the verify lane is off.
+        self.verify_counts = verify_counts or {}
         # Anchored at capture START (not completion): any ingest acked
         # before this instant had released the fold lock before the
         # capture acquired it, so it is provably inside the view — and
@@ -334,12 +338,16 @@ class TableView:
             return None
         total = (int(self.issuer_totals[idx])
                  if idx < self.issuer_totals.shape[0] else 0)
-        return {
+        meta = {
             "issuer": issuer_id,
             "unknown_total": total,
             "crls": int(self.crl_counts.get(idx, 0)),
             "dns": int(self.dn_counts.get(idx, 0)),
         }
+        vc = self.verify_counts.get(issuer_id)
+        if vc is not None:
+            meta["verified"], meta["failed"] = int(vc[0]), int(vc[1])
+        return meta
 
 
 def capture_view(agg, epoch: int, device: bool = False,
@@ -373,6 +381,7 @@ def capture_view(agg, epoch: int, device: bool = False,
         issuer_totals = agg.issuer_totals.copy()
         crl_counts = {i: len(s) for i, s in agg.crl_sets.items()}
         dn_counts = {i: len(s) for i, s in agg.dn_sets.items()}
+        verify_counts = agg.verify_counts()
         table_fill = agg._table_fill
     return TableView(
         epoch=epoch, rows=rows, layout=layout, n_shards=n_shards,
@@ -384,6 +393,7 @@ def capture_view(agg, epoch: int, device: bool = False,
         device=device,
         devices=devices,
         created_wall=t0,
+        verify_counts=verify_counts,
     )
 
 

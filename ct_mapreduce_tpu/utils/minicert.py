@@ -198,6 +198,33 @@ def make_cert(
     return _tlv(0x30, tbs + sig_alg + sig)
 
 
+def make_sct_cert(
+    serial: int = 1,
+    issuer_cn: str = "Mini Issuer CA",
+    subject_cn: str | None = None,
+    sct_signer=None,
+    sct_timestamp_ms: int = 1_700_000_000_000,
+    sct_extensions: bytes = b"",
+    corrupt_signature: bool = False,
+    **kwargs,
+) -> bytes:
+    """A canonical-DER certificate with an embedded, genuinely-signed
+    SCT (the round-13 verification fixtures). ``sct_signer`` defaults
+    to a deterministic P-256 log key seeded by the issuer CN — same
+    dependency-free contract as the rest of this module, so verify
+    tests collect and pass on hosts without ``cryptography``."""
+    from ct_mapreduce_tpu.verify import sct as sctlib
+
+    der = make_cert(serial=serial, issuer_cn=issuer_cn,
+                    subject_cn=subject_cn, **kwargs)
+    if sct_signer is None:
+        sct_signer = sctlib.EcSctSigner(f"minicert-log:{issuer_cn}")
+    return sctlib.attach_sct(
+        der, sct_signer, sct_timestamp_ms, extensions=sct_extensions,
+        corrupt_signature=corrupt_signature,
+    )
+
+
 def make_ca_and_leaf(
     serial: int,
     issuer_cn: str = "Mini Issuer CA",

@@ -1,0 +1,15 @@
+"""Signature verification: the auditing workload (round 13).
+
+- :mod:`ct_mapreduce_tpu.verify.host` — the pure-python reference
+  verifier (generic short-Weierstrass ECDSA + RSA PKCS#1 v1.5). The
+  ground truth every device verdict is bit-identical to, and the
+  fallback lane for signatures the device kernel doesn't cover.
+- :mod:`ct_mapreduce_tpu.verify.sct` — the embedded-SCT wire format:
+  extension scan, TLS SCT-list parsing, the reproduction's signed-
+  payload convention, fixture signers, and DER surgery to embed SCTs
+  into any certificate.
+- :mod:`ct_mapreduce_tpu.verify.lane` — the ingest-side verification
+  lane: log-key registry, device-batch staging with async dispatch,
+  host-fallback replay, per-issuer verified/failed fold into the
+  aggregator.
+"""

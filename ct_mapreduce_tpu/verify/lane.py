@@ -1,0 +1,260 @@
+"""The ingest-side signature-verification lane (``verifySignatures``).
+
+``SignatureVerifier`` sits beside the dedup dispatch in
+``AggregatorSink``: each prepared chunk's extracted SCT tuples
+(:func:`ct_mapreduce_tpu.native.leafpack.extract_scts`) are classified
+per lane —
+
+- **device** — P-256-shaped SCT (extractor status ``SCT_OK``) whose
+  log key is a registered P-256 key: staged into a fixed-width batch
+  for the jitted :func:`ct_mapreduce_tpu.ops.ecdsa.verify_p256_jit`
+  kernel, dispatched asynchronously (the pending deque mirrors the
+  sink's dedup pipelining), folded under the aggregator's fold lock.
+- **host fallback** — SCT present but not device-decidable (odd
+  curves, RSA signatures, malformed DER innards — extractor status
+  ``SCT_FALLBACK``), or device-shaped but keyed to a non-P-256 log:
+  replayed through the pure-python reference verifier from the lane's
+  row bytes. Verdicts are bit-identical to the host verifier by
+  construction on BOTH lanes — the device kernel is parity-pinned
+  against the same reference.
+- **no_key / no_sct** — counted, not judged: an unregistered log id
+  cannot be verified anywhere, and most certs simply carry no SCT.
+
+Results land on the aggregator as per-issuer verified/failed vectors
+(surfaced via drain()/storage-statistics, the query plane's
+``/issuer/<id>``, and checkpoints) plus ``verify.*`` telemetry
+counters and ``device.verify`` spans.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ct_mapreduce_tpu.telemetry import trace
+from ct_mapreduce_tpu.telemetry.metrics import add_sample, incr_counter
+from ct_mapreduce_tpu.verify import sct as sctlib
+
+DEFAULT_BATCH = 1024
+
+
+def resolve_verify(flag: Optional[bool] = None,
+                   keys_path: Optional[str] = None,
+                   batch: int = 0) -> tuple[bool, str, int]:
+    """Resolve the verify-lane knobs: explicit value (config directive
+    / kwarg) > ``CTMR_VERIFY`` / ``CTMR_VERIFY_KEYS`` /
+    ``CTMR_VERIFY_BATCH`` env > defaults (off; no key file; 1024-lane
+    device batches). Unparseable env values are ignored, matching the
+    config layer's tolerance."""
+    if flag is None:
+        flag = os.environ.get("CTMR_VERIFY", "0") == "1"
+    if not keys_path:
+        keys_path = os.environ.get("CTMR_VERIFY_KEYS", "")
+    b = int(batch or 0)
+    if b <= 0:
+        try:
+            b = int(os.environ.get("CTMR_VERIFY_BATCH", "0") or 0)
+        except ValueError:
+            b = 0
+    return bool(flag), keys_path, (b if b > 0 else DEFAULT_BATCH)
+
+
+class LogKeyRegistry:
+    """log_id (32 bytes) → key entry dict, the trust anchors of the
+    verify lane. Entries are the JSON shape the fixture signers emit
+    (:meth:`~ct_mapreduce_tpu.verify.sct.EcSctSigner.key_entry`):
+    ``{"log_id": hex, "alg": "p256"|"p384"|"rsa", ...}``."""
+
+    def __init__(self) -> None:
+        self._keys: dict[bytes, dict] = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def register(self, entry: dict) -> None:
+        with self._lock:
+            self._keys[bytes.fromhex(entry["log_id"])] = dict(entry)
+
+    def register_signer(self, signer) -> None:
+        self.register(signer.key_entry())
+
+    def get(self, log_id: bytes) -> Optional[dict]:
+        return self._keys.get(log_id)
+
+    def is_p256(self, log_id: bytes) -> bool:
+        e = self._keys.get(log_id)
+        return e is not None and e.get("alg") == "p256"
+
+    def to_json(self) -> str:
+        with self._lock:
+            entries = [
+                {k: v for k, v in e.items() if not k.startswith("_")}
+                for e in self._keys.values()
+            ]  # "_"-prefixed keys are runtime caches (_key_coord)
+            return json.dumps(sorted(entries, key=lambda e: e["log_id"]))
+
+    @classmethod
+    def from_json_file(cls, path: str) -> "LogKeyRegistry":
+        reg = cls()
+        with open(path) as fh:
+            for entry in json.load(fh):
+                reg.register(entry)
+        return reg
+
+
+class _PendingVerify:
+    """One dispatched device verify batch awaiting readback."""
+
+    def __init__(self, out, n: int, issuer_idx: np.ndarray) -> None:
+        self.out = out  # device bool[width]
+        self.n = n
+        self.issuer_idx = issuer_idx  # int32[n]
+
+
+class SignatureVerifier:
+    """Batches device-eligible SCT lanes across chunks and folds
+    verdicts into the aggregator. All entry points are called under
+    the sink's dispatch lock (one device stream), so internal state
+    needs no extra locking; aggregator folds take the fold lock."""
+
+    def __init__(self, agg, keys: Optional[LogKeyRegistry] = None,
+                 batch_width: int = DEFAULT_BATCH, depth: int = 2) -> None:
+        self.agg = agg
+        self.keys = keys if keys is not None else LogKeyRegistry()
+        self.batch_width = max(16, int(batch_width))
+        self.depth = max(0, int(depth))
+        self._buf: list[tuple] = []  # (digest, r, s, qx, qy, issuer_idx)
+        self._inflight: deque[_PendingVerify] = deque()
+        # Scalar outcomes (also exported as verify.* counters; kept
+        # here so tests and the bench leg can read exact totals).
+        self.stats = {
+            "device_lanes": 0, "host_lanes": 0, "no_sct": 0,
+            "no_key": 0, "verified": 0, "failed": 0, "batches": 0,
+        }
+
+    # -- classification + staging ---------------------------------------
+    def submit_chunk(self, scts: sctlib.SctBatch, issuer_idx: np.ndarray,
+                     eligible: np.ndarray, rows: np.ndarray,
+                     lengths: np.ndarray) -> None:
+        """Route one prepared chunk's SCT lanes. ``eligible`` marks
+        lanes that decoded OK with a mapped issuer (the verify universe
+        — filtered/duplicate lanes still carry auditable SCTs)."""
+        eligible = np.asarray(eligible, bool)
+        ok = scts.ok
+        no_sct = int((eligible & (ok == sctlib.SCT_NONE)).sum())
+        if no_sct:
+            self.stats["no_sct"] += no_sct
+            incr_counter("verify", "no_sct", value=float(no_sct))
+        lanes = np.nonzero(eligible & (ok != sctlib.SCT_NONE))[0]
+        host_lanes: list[int] = []
+        for i in lanes:
+            i = int(i)
+            log_id = scts.log_id[i].tobytes()
+            key = self.keys.get(log_id)
+            if key is None:
+                self.stats["no_key"] += 1
+                incr_counter("verify", "no_key")
+                continue
+            if ok[i] == sctlib.SCT_OK and key.get("alg") == "p256":
+                self._buf.append((
+                    scts.digest[i], scts.r[i], scts.s[i],
+                    _key_coord(key, "x"), _key_coord(key, "y"),
+                    int(issuer_idx[i]),
+                ))
+            else:
+                host_lanes.append(i)
+        if host_lanes:
+            self._host_verify(host_lanes, scts, issuer_idx, rows, lengths)
+        while len(self._buf) >= self.batch_width:
+            self._dispatch(self.batch_width)
+        self._drain_inflight(self.depth)
+
+    def _host_verify(self, lanes, scts, issuer_idx, rows, lengths) -> None:
+        """The fallback lane: re-extract each lane's SCT from its row
+        bytes (the compact batch doesn't carry fallback signatures) and
+        judge it with the pure-python reference verifier."""
+        verdicts = np.zeros((len(lanes),), bool)
+        idx = np.zeros((len(lanes),), np.int64)
+        for j, i in enumerate(lanes):
+            der = rows[i, : int(lengths[i])].tobytes()
+            _status, sc, digest, _r, _s = sctlib.extract_sct_lane(der)
+            key = self.keys.get(scts.log_id[i].tobytes())
+            verdicts[j] = (sc is not None and key is not None
+                           and sctlib.host_verify_sct(digest, sc, key))
+            idx[j] = int(issuer_idx[i])
+        self.stats["host_lanes"] += len(lanes)
+        incr_counter("verify", "host_lanes", value=float(len(lanes)))
+        self._fold_verdicts(verdicts, idx)
+
+    # -- device lane -----------------------------------------------------
+    def _dispatch(self, take: int) -> None:
+        from ct_mapreduce_tpu.ops import ecdsa
+
+        batch, self._buf = self._buf[:take], self._buf[take:]
+        n = len(batch)
+        w = self.batch_width  # ONE compiled width per verifier
+        arr = lambda k: np.stack([b[k] for b in batch])  # noqa: E731
+
+        def pad(a):
+            return np.pad(np.ascontiguousarray(a, np.uint8),
+                          ((0, w - n), (0, 0)))
+
+        valid = np.pad(np.ones((n,), bool), (0, w - n))
+        with trace.span("device.verify", cat="device", lanes=n):
+            out = ecdsa.verify_p256_jit(
+                pad(arr(0)), pad(arr(1)), pad(arr(2)),
+                pad(arr(3)), pad(arr(4)), valid,
+            )
+        self.stats["batches"] += 1
+        self.stats["device_lanes"] += n
+        incr_counter("verify", "batches")
+        incr_counter("verify", "device_lanes", value=float(n))
+        add_sample("verify", "batch_lanes", value=float(n))
+        self._inflight.append(_PendingVerify(
+            out, n, np.array([b[5] for b in batch], np.int64)))
+
+    def _drain_inflight(self, keep: int) -> None:
+        while len(self._inflight) > keep:
+            p = self._inflight.popleft()
+            verdicts = np.asarray(p.out)[: p.n]  # the blocking read
+            self._fold_verdicts(verdicts, p.issuer_idx)
+
+    def _fold_verdicts(self, verdicts: np.ndarray,
+                       issuer_idx: np.ndarray) -> None:
+        if len(verdicts) == 0:
+            return
+        v = int(verdicts.sum())
+        f = len(verdicts) - v
+        self.stats["verified"] += v
+        self.stats["failed"] += f
+        if v:
+            incr_counter("verify", "verified", value=float(v))
+        if f:
+            incr_counter("verify", "failed", value=float(f))
+        agg = self.agg
+        with agg._fold_lock:
+            agg.grow_verify_totals(int(issuer_idx.max(initial=0)))
+            np.add.at(agg.verify_verified, issuer_idx, verdicts)
+            np.add.at(agg.verify_failed, issuer_idx, ~verdicts)
+
+    def drain(self) -> None:
+        """Flush the staging buffer (padding the tail to the compiled
+        width) and fold every outstanding batch."""
+        while self._buf:
+            self._dispatch(min(len(self._buf), self.batch_width))
+        self._drain_inflight(0)
+
+
+def _key_coord(key: dict, name: str) -> np.ndarray:
+    c = key.get(f"_{name}_bytes")
+    if c is None:
+        c = np.frombuffer(
+            int(key[name], 16).to_bytes(32, "big"), np.uint8)
+        key[f"_{name}_bytes"] = c  # parse hex once per key
+    return c

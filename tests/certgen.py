@@ -168,6 +168,40 @@ def _make_cert_minicert(*, serial, issuer_cn, subject_cn, org, country,
         extras_first=extras_first)
 
 
+def sct_signer(seed: str = "certgen-log:0", kind: str = "p256"):
+    """Deterministic fixture CT-log signer (shared across tests so
+    log ids — and with them key registries — are stable per seed).
+    ``kind``: p256 (device-decidable) | p384 | rsa (host fallback)."""
+    from ct_mapreduce_tpu.verify import host, sct as sctlib
+
+    if kind == "rsa":
+        return sctlib.RsaSctSigner()
+    curve = host.CURVES[kind]
+    return sctlib.EcSctSigner(seed, curve)
+
+
+def make_sct_cert(
+    signer=None,
+    sct_timestamp_ms: int = 1_700_000_000_000,
+    sct_extensions: bytes = b"",
+    corrupt_signature: bool = False,
+    **kwargs,
+) -> bytes:
+    """An SCT-embedded fixture cert: :func:`make_cert` (cryptography
+    when present, minicert otherwise — identical degradation contract)
+    plus DER surgery embedding a genuinely-signed SCT
+    (:func:`ct_mapreduce_tpu.verify.sct.attach_sct`)."""
+    from ct_mapreduce_tpu.verify import sct as sctlib
+
+    der = make_cert(**kwargs)
+    if signer is None:
+        signer = sct_signer()
+    return sctlib.attach_sct(
+        der, signer, sct_timestamp_ms, extensions=sct_extensions,
+        corrupt_signature=corrupt_signature,
+    )
+
+
 def spki_of(der: bytes) -> bytes:
     if HAVE_CRYPTOGRAPHY:
         cert = x509.load_der_x509_certificate(der)

@@ -44,3 +44,8 @@ def pytest_configure(config):
         "timeout(seconds): per-test timeout (enforced only when "
         "pytest-timeout is installed)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: outside the tier-1 budget (tier-1 runs -m 'not slow'); "
+        "e.g. per-batch-width ECDSA kernel compiles",
+    )

@@ -213,3 +213,32 @@ def test_serve_tier_directives(tmp_path):
     usage = CTConfig().usage()
     for d in ("serveReplicas", "serveDevice", "serveCacheSize"):
         assert d in usage
+
+
+def test_verify_directives(tmp_path):
+    """verifySignatures / verifyLogKeys (ISSUE 8): ini + env layering,
+    bool parse, defaults, usage(). The CTMR_VERIFY env equivalent
+    layers downstream (verify.lane.resolve_verify, covered by
+    tests/test_verify_lane.py)."""
+    ini = tmp_path / "ct.ini"
+    ini.write_text(
+        "verifySignatures = true\nverifyLogKeys = /etc/ct/keys.json\n")
+    cfg = CTConfig.load(argv=["--config", str(ini)], env={})
+    assert cfg.verify_signatures is True
+    assert cfg.verify_log_keys == "/etc/ct/keys.json"
+    cfg2 = CTConfig.load(
+        argv=["--config", str(ini)],
+        env={"verifySignatures": "false",
+             "verifyLogKeys": "/run/keys.json"})
+    assert cfg2.verify_signatures is False
+    assert cfg2.verify_log_keys == "/run/keys.json"
+    # Unparseable env bool falls back to the file value.
+    cfg3 = CTConfig.load(argv=["--config", str(ini)],
+                         env={"verifySignatures": "maybe"})
+    assert cfg3.verify_signatures is True
+    dflt = CTConfig.load(argv=[], env={})
+    assert dflt.verify_signatures is False
+    assert dflt.verify_log_keys == ""
+    usage = CTConfig().usage()
+    for d in ("verifySignatures", "verifyLogKeys"):
+        assert d in usage

@@ -1,0 +1,343 @@
+"""Round-13 verification kernels: known-answer corpus + parity fuzz.
+
+The contract under test: the batched device kernel
+(ops/ecdsa.verify_p256) is bit-identical to the pure-python reference
+verifier (verify/host.verify_ecdsa) on EVERY input — valid
+signatures, Wycheproof-style edge classes (r/s = 0, r/s ≥ n,
+non-canonical s, off-curve and out-of-range public keys, wrong
+digests), and a ≥400-case mutation fuzz — and the native SCT
+extraction pass (ctmr_extract_scts) is bit-identical to its python
+mirror (verify/sct.extract_scts_np) on well-formed and mutated rows.
+
+Compile budget: the ECDSA ladder compiles in ~20 s per batch width on
+the 1-core CI box, so every tier-1 device call in this file — and in
+the verify bench leg and the lane tests — pads to ONE shared width
+(32): one compile per process, total. The explicit multi-width parity
+sweep runs as a ``slow`` test (widths 64/128 add a compile each).
+"""
+
+import hashlib
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ct_mapreduce_tpu.ops import bigint, ecdsa  # noqa: E402
+from ct_mapreduce_tpu.verify import host, sct as sctlib  # noqa: E402
+
+C = host.P256
+WIDTH = 32
+
+
+def _b32(v: int) -> np.ndarray:
+    return np.frombuffer(v.to_bytes(32, "big"), np.uint8).copy()
+
+
+def _key(seed: str):
+    d = host.derive_scalar(seed)
+    return d, host._point_mul(C, d, (C.gx, C.gy))
+
+
+def _sign(seed: str, msg: bytes):
+    d, q = _key(seed)
+    digest = hashlib.sha256(msg).digest()
+    r, s = host.sign_ecdsa(C, digest, d, host.derive_nonce(seed, msg))
+    return digest, r, s, q
+
+
+def _dverify(rows, width: int = WIDTH):
+    """Device verdicts for [(digest, r, s, x, y)] int/bytes tuples,
+    padded to the shared compile width."""
+    assert len(rows) <= width
+    n = len(rows)
+    z = np.zeros((width, 32), np.uint8)
+    digest, r, s, qx, qy = (z.copy() for _ in range(5))
+    for i, (dg, ri, si, xi, yi) in enumerate(rows):
+        digest[i] = np.frombuffer(dg, np.uint8)
+        r[i], s[i] = _b32(ri % (1 << 256)), _b32(si % (1 << 256))
+        qx[i], qy[i] = _b32(xi % (1 << 256)), _b32(yi % (1 << 256))
+    valid = np.zeros((width,), bool)
+    valid[:n] = True
+    out = np.asarray(ecdsa.verify_p256_jit(digest, r, s, qx, qy, valid))
+    return out[:n].tolist()
+
+
+def _hverify(rows):
+    return [
+        host.verify_ecdsa(C, dg, ri % (1 << 256), si % (1 << 256),
+                          xi % (1 << 256), yi % (1 << 256))
+        for dg, ri, si, xi, yi in rows
+    ]
+
+
+def _kat_corpus():
+    """(name, row, expected) — the pinned edge classes."""
+    cases = []
+    dg, r, s, q = _sign("kat-a", b"hello ct")
+    dg2, r2, s2, q2 = _sign("kat-b", b"second key")
+    cases += [
+        ("valid-a", (dg, r, s, q[0], q[1]), True),
+        ("valid-b", (dg2, r2, s2, q2[0], q2[1]), True),
+        ("wrong-digest", (hashlib.sha256(b"x").digest(), r, s, q[0], q[1]),
+         False),
+        ("wrong-key", (dg, r, s, q2[0], q2[1]), False),
+        ("r-zero", (dg, 0, s, q[0], q[1]), False),
+        ("s-zero", (dg, r, 0, q[0], q[1]), False),
+        ("r-eq-n", (dg, C.n, s, q[0], q[1]), False),
+        ("s-eq-n", (dg, r, C.n, q[0], q[1]), False),
+        ("r-over-n", (dg, C.n + 5, s, q[0], q[1]), False),
+        ("s-over-n", (dg, r, (C.n + r) % (1 << 256), q[0], q[1]), False),
+        # (r, n - s) is the alternate encoding of a VALID signature —
+        # plain ECDSA accepts the non-canonical s.
+        ("noncanonical-s", (dg, r, C.n - s, q[0], q[1]), True),
+        ("swapped-rs", (dg, s, r, q[0], q[1]), False),
+        ("pub-off-curve", (dg, r, s, q[0], q[1] ^ 1), False),
+        ("pub-zero", (dg, r, s, 0, 0), False),
+        ("pub-x-eq-p", (dg, r, s, C.p, q[1]), False),
+        ("pub-y-over-p", (dg, r, s, q[0], C.p + q[1]), False),
+        # x = 0 with a matching on-curve y: y^2 = b — may not have a
+        # root; use negated-y instead (on curve, wrong key half).
+        ("pub-neg-y", (dg, r, s, q[0], C.p - q[1]), False),
+    ]
+    return cases
+
+
+def test_known_answer_corpus():
+    cases = _kat_corpus()
+    rows = [c[1] for c in cases]
+    expected = [c[2] for c in cases]
+    hv = _hverify(rows)
+    assert hv == expected, [c[0] for c, h, e in
+                            zip(cases, hv, expected) if h != e]
+    dv = _dverify(rows)
+    assert dv == expected, [c[0] for c, d, e in
+                            zip(cases, dv, expected) if d != e]
+
+
+def test_all_valid_and_all_invalid_batches():
+    valid_rows = []
+    for i in range(WIDTH):
+        dg, r, s, q = _sign(f"fill-{i % 5}", b"m%d" % i)
+        valid_rows.append((dg, r, s, q[0], q[1]))
+    assert _dverify(valid_rows) == [True] * WIDTH
+    invalid_rows = [(dg, 0, s, x, y) for dg, _r, s, x, y in valid_rows]
+    assert _dverify(invalid_rows) == [False] * WIDTH
+
+
+def test_padding_mask_parity():
+    """Verdicts are invariant to where lanes sit in the padded batch:
+    the same rows scattered behind invalid filler lanes answer
+    identically (the valid mask really gates, padding garbage cannot
+    leak into live lanes)."""
+    cases = _kat_corpus()[:10]
+    rows = [c[1] for c in cases]
+    base = _dverify(rows)
+    filler = _sign("pad-filler", b"pad")
+    mixed = []
+    for r in rows:
+        mixed.append((filler[0], 0, 0, 0, 0))  # dead-invalid lane
+        mixed.append(r)
+    out = _dverify(mixed)
+    assert out[1::2] == base
+
+
+@pytest.mark.slow
+def test_batch_width_parity_wide():
+    """Same lanes at freshly-compiled widths 64 and 128 → identical
+    verdicts (width-invariance of the pow2-padded dispatch). Slow:
+    each width is its own ~20 s XLA compile on the CI box."""
+    cases = _kat_corpus()
+    rows = [c[1] for c in cases]
+    expected = [c[2] for c in cases]
+    assert _dverify(rows, width=64) == expected
+    assert _dverify(rows, width=128) == expected
+
+
+def test_mutation_fuzz_device_host_parity():
+    """≥400 mutated signatures: the device verdict equals the host
+    verdict on every lane (acceptance gate). Mutations hit every
+    input field; ~1/8 lanes are left untouched (valid)."""
+    rng = random.Random(0x5C7)
+    rows = []
+    for i in range(13 * WIDTH):  # 416 cases
+        dg, r, s, q = _sign(f"fuzz-{i % 7}", b"fz%d" % (i % 29))
+        row = [bytearray(dg), r, s, q[0], q[1]]
+        kind = rng.randrange(8)
+        if kind == 1:
+            row[0][rng.randrange(32)] ^= 1 << rng.randrange(8)
+        elif kind == 2:
+            row[1] ^= 1 << rng.randrange(256)
+        elif kind == 3:
+            row[2] ^= 1 << rng.randrange(256)
+        elif kind == 4:
+            row[3] ^= 1 << rng.randrange(256)
+        elif kind == 5:
+            row[4] ^= 1 << rng.randrange(256)
+        elif kind == 6:
+            row[rng.randrange(1, 5)] = rng.getrandbits(256)
+        elif kind == 7:
+            row[2] = C.n - row[2]  # stays valid
+        rows.append((bytes(row[0]), row[1], row[2], row[3], row[4]))
+    mismatches = []
+    for lo in range(0, len(rows), WIDTH):
+        chunk = rows[lo : lo + WIDTH]
+        dv = _dverify(chunk)
+        hv = _hverify(chunk)
+        mismatches += [lo + j for j, (d, h) in enumerate(zip(dv, hv))
+                       if d != h]
+    assert not mismatches, mismatches
+    assert len(rows) >= 400
+
+
+# -- big-int layer -------------------------------------------------------
+
+def test_montgomery_arithmetic_against_python_ints():
+    import jax
+
+    rng = random.Random(7)
+    mod = bigint.P256_P
+    a_int = [rng.getrandbits(256) % bigint.P256_P_INT for _ in range(32)]
+    b_int = [rng.getrandbits(256) % bigint.P256_P_INT for _ in range(32)]
+    a = np.stack([bigint.limbs_from_int(v) for v in a_int])
+    b = np.stack([bigint.limbs_from_int(v) for v in b_int])
+
+    @jax.jit
+    def modmul(a, b):
+        am = bigint.to_mont(a, mod)
+        bm = bigint.to_mont(b, mod)
+        return (
+            bigint.from_mont(bigint.mont_mul(am, bm, mod), mod),
+            bigint.add_mod(a, b, mod),
+            bigint.sub_mod(a, b, mod),
+        )
+
+    prod, s, d = modmul(a, b)
+    for i in range(32):
+        p = bigint.P256_P_INT
+        assert bigint.int_from_limbs(np.asarray(prod)[i]) \
+            == a_int[i] * b_int[i] % p
+        assert bigint.int_from_limbs(np.asarray(s)[i]) \
+            == (a_int[i] + b_int[i]) % p
+        assert bigint.int_from_limbs(np.asarray(d)[i]) \
+            == (a_int[i] - b_int[i]) % p
+
+
+def test_mont_inv_random():
+    import jax
+
+    rng = random.Random(9)
+    mod = bigint.P256_N
+    vals = [rng.getrandbits(255) % (bigint.P256_N_INT - 1) + 1
+            for _ in range(8)]
+    a = np.stack([bigint.limbs_from_int(v) for v in vals])
+
+    @jax.jit
+    def inv(a):
+        return bigint.from_mont(
+            bigint.mont_inv(bigint.to_mont(a, mod), mod), mod)
+
+    out = np.asarray(inv(a))
+    for i, v in enumerate(vals):
+        assert bigint.int_from_limbs(out[i]) \
+            == pow(v, -1, bigint.P256_N_INT)
+
+
+# -- extraction parity ---------------------------------------------------
+
+def _sct_corpus():
+    from ct_mapreduce_tpu.utils import minicert
+
+    base = minicert.make_cert(serial=7, issuer_cn="Extract CA",
+                              crl_dps=("http://crl.example/x",))
+    plain = minicert.make_cert(serial=9, issuer_cn="NoExt CA",
+                               add_basic_constraints=False)
+    p256 = sctlib.EcSctSigner("ext-a")
+    p384 = sctlib.EcSctSigner("ext-b", host.P384)
+    rsa = sctlib.RsaSctSigner()
+    certs = [
+        sctlib.attach_sct(base, p256, 1_700_000_000_000),
+        sctlib.attach_sct(base, p256, 1_700_000_000_001,
+                          corrupt_signature=True),
+        sctlib.attach_sct(base, p384, 1_700_000_000_002),
+        sctlib.attach_sct(base, rsa, 1_700_000_000_003),
+        base,
+        sctlib.attach_sct(base, p256, 1_700_000_000_004,
+                          extensions=b"hello"),
+        sctlib.attach_sct(plain, p256, 5),
+    ]
+    rng = random.Random(1)
+    for k in range(64):
+        c = bytearray(certs[k % 7])
+        for _ in range(rng.randrange(1, 4)):
+            c[rng.randrange(len(c))] ^= 1 << rng.randrange(8)
+        certs.append(bytes(c))
+    pad = max(len(c) for c in certs) + 32
+    data = np.zeros((len(certs), pad), np.uint8)
+    length = np.zeros((len(certs),), np.int32)
+    for i, c in enumerate(certs):
+        data[i, : len(c)] = np.frombuffer(c, np.uint8)
+        length[i] = len(c)
+    return data, length
+
+
+def test_sct_extraction_classes():
+    data, length = _sct_corpus()
+    out = sctlib.extract_scts_np(data, length)
+    assert out.ok[:7].tolist() == [1, 1, 2, 2, 0, 1, 1]
+
+
+def test_native_extraction_parity():
+    from ct_mapreduce_tpu.native import available, leafpack
+
+    if not available() or not getattr(
+            __import__("ct_mapreduce_tpu.native", fromlist=["load"]).load(),
+            "has_sct", False):
+        pytest.skip("native SCT extractor unavailable")
+    data, length = _sct_corpus()
+    py = sctlib.extract_scts_np(data, length)
+    for threads in (1, 4):
+        nat = leafpack.extract_scts(data, length, threads=threads)
+        for fld in ("ok", "digest", "log_id", "timestamp_ms", "r", "s",
+                    "hash_alg", "sig_alg"):
+            assert np.array_equal(getattr(nat, fld), getattr(py, fld)), \
+                (threads, fld)
+
+
+def test_extract_scts_python_fallback(monkeypatch):
+    """CTMR_NATIVE=0 routes leafpack.extract_scts down the python
+    mirror — same outputs (the degradation contract)."""
+    from ct_mapreduce_tpu.native import leafpack
+
+    data, length = _sct_corpus()
+    monkeypatch.setenv("CTMR_NATIVE", "0")
+    fb = leafpack.extract_scts(data, length)
+    monkeypatch.delenv("CTMR_NATIVE")
+    py = sctlib.extract_scts_np(data, length)
+    assert np.array_equal(fb.ok, py.ok)
+    assert np.array_equal(fb.digest, py.digest)
+
+
+def test_registry_json_roundtrip(tmp_path):
+    from ct_mapreduce_tpu.verify.lane import LogKeyRegistry
+
+    reg = LogKeyRegistry()
+    signers = [sctlib.EcSctSigner("rt-a"),
+               sctlib.EcSctSigner("rt-b", host.P384),
+               sctlib.RsaSctSigner()]
+    for s in signers:
+        reg.register_signer(s)
+    # exercise the coordinate cache, then round-trip
+    from ct_mapreduce_tpu.verify.lane import _key_coord
+
+    _key_coord(reg.get(signers[0].log_id), "x")
+    path = tmp_path / "keys.json"
+    path.write_text(reg.to_json())
+    reg2 = LogKeyRegistry.from_json_file(str(path))
+    assert len(reg2) == 3
+    assert reg2.is_p256(signers[0].log_id)
+    assert not reg2.is_p256(signers[1].log_id)
+    assert reg2.get(signers[2].log_id)["alg"] == "rsa"

@@ -1,0 +1,251 @@
+"""Ingest-side signature-verification lane (round 13).
+
+End-to-end through AggregatorSink: extraction → classification →
+batched device ECDSA + pure-python host fallback → per-issuer fold,
+under both the serial per-chunk dispatch and the staged device queue,
+with verdict truth recomputed independently per lane. Budget
+discipline: device batches pad to width 32 (the compile the ECDSA
+parity suite already paid), ONE serial sink run is shared module-wide
+by every read-side assertion (checkpoint / issuer meta / reports),
+and the walker compiles reuse one batch shape.
+"""
+
+import base64
+import datetime
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ct_mapreduce_tpu.agg.aggregator import (  # noqa: E402
+    HostSnapshotAggregator,
+    TpuAggregator,
+)
+from ct_mapreduce_tpu.ingest import leaf as leaflib  # noqa: E402
+from ct_mapreduce_tpu.ingest.sync import AggregatorSink, RawBatch  # noqa: E402
+from ct_mapreduce_tpu.utils import minicert  # noqa: E402
+from ct_mapreduce_tpu.verify import host, sct as sctlib  # noqa: E402
+from ct_mapreduce_tpu.verify.lane import (  # noqa: E402
+    LogKeyRegistry,
+    SignatureVerifier,
+    resolve_verify,
+)
+
+FUTURE = datetime.datetime(2031, 6, 15, tzinfo=datetime.timezone.utc)
+
+
+def _signers():
+    return (sctlib.EcSctSigner("vl-a"),
+            sctlib.EcSctSigner("vl-b", host.P384),
+            sctlib.RsaSctSigner())
+
+
+def _corpus(n=24):
+    """[(leaf_der, issuer_der)] + expected outcome totals."""
+    issuer = minicert.make_cert(serial=1, issuer_cn="Verify CA",
+                                is_ca=True, not_after=FUTURE)
+    p256, p384, rsa = _signers()
+    unknown = sctlib.EcSctSigner("vl-unknown")
+    pairs, expect = [], dict(verified=0, failed=0, no_sct=0, no_key=0,
+                             host=0, device=0)
+    for s in range(n):
+        base = minicert.make_cert(
+            serial=1000 + s, issuer_cn="Verify CA", subject_cn=f"l{s}",
+            is_ca=False, not_after=FUTURE)
+        kind = s % 6
+        if kind == 0:
+            der = sctlib.attach_sct(base, p256, 10**12 + s)
+            expect["verified"] += 1
+            expect["device"] += 1
+        elif kind == 1:
+            der = sctlib.attach_sct(base, p256, 10**12 + s,
+                                    corrupt_signature=True)
+            expect["failed"] += 1
+            expect["device"] += 1
+        elif kind == 2:
+            der = sctlib.attach_sct(base, p384, 10**12 + s)
+            expect["verified"] += 1
+            expect["host"] += 1
+        elif kind == 3:
+            der = sctlib.attach_sct(base, rsa, 10**12 + s,
+                                    corrupt_signature=True)
+            expect["failed"] += 1
+            expect["host"] += 1
+        elif kind == 4:
+            der = base
+            expect["no_sct"] += 1
+        else:
+            der = sctlib.attach_sct(base, unknown, 10**12 + s)
+            expect["no_key"] += 1
+        pairs.append((der, issuer))
+    return pairs, expect
+
+
+def _wire(pairs):
+    lis = [base64.b64encode(leaflib.encode_leaf_input(
+        leaf, timestamp_ms=1_700_000_000_000 + j)).decode()
+        for j, (leaf, _) in enumerate(pairs)]
+    eds = [base64.b64encode(leaflib.encode_extra_data([iss])).decode()
+           for _, iss in pairs]
+    return lis, eds
+
+
+def _run_sink(pairs, chunks_per_dispatch=1, flush=16):
+    agg = TpuAggregator(capacity=1 << 12, batch_size=flush)
+    sink = AggregatorSink(agg, flush_size=flush, device_queue_depth=0,
+                          verify_signatures=True,
+                          chunks_per_dispatch=chunks_per_dispatch)
+    sink.verifier.batch_width = 32  # the parity suite's compiled width
+    for s in _signers():
+        sink.verifier.keys.register_signer(s)
+    lis, eds = _wire(pairs)
+    sink.store_raw_batch(RawBatch(lis, eds, 0, "v-log"))
+    sink.flush()
+    return agg, sink
+
+
+@pytest.fixture(scope="module")
+def serial_run():
+    """One serial-dispatch sink run, shared by every read-side test."""
+    pairs, expect = _corpus()
+    agg, sink = _run_sink(pairs)
+    return pairs, expect, agg, sink
+
+
+def _check_outcomes(agg, sink, expect, n_pairs):
+    st = sink.verifier.stats
+    assert st["verified"] == expect["verified"]
+    assert st["failed"] == expect["failed"]
+    assert st["no_sct"] == expect["no_sct"]
+    assert st["no_key"] == expect["no_key"]
+    assert st["host_lanes"] == expect["host"]
+    assert st["device_lanes"] == expect["device"]
+    vc = agg.verify_counts()
+    assert sum(v for v, _ in vc.values()) == expect["verified"]
+    assert sum(f for _, f in vc.values()) == expect["failed"]
+    # The dedup side is untouched by the lane: every lane still counts.
+    assert agg.metrics["inserted"] == n_pairs
+
+
+def test_sink_lane_outcomes_serial(serial_run):
+    pairs, expect, agg, sink = serial_run
+    _check_outcomes(agg, sink, expect, len(pairs))
+
+
+def test_sink_lane_outcomes_staged():
+    pairs, expect = _corpus()
+    agg, sink = _run_sink(pairs, chunks_per_dispatch=2)
+    _check_outcomes(agg, sink, expect, len(pairs))
+
+
+def test_lane_python_extraction_parity(serial_run, monkeypatch):
+    """CTMR_NATIVE=0 (pure-python decode AND extraction) produces the
+    exact same verify outcomes — the degradation contract end to end."""
+    pairs, expect, _agg, native_sink = serial_run
+    monkeypatch.setenv("CTMR_NATIVE", "0")
+    agg, sink = _run_sink(pairs)
+    assert sink.verifier.stats == native_sink.verifier.stats
+
+
+def test_verify_off_means_no_verifier():
+    agg = TpuAggregator(capacity=1 << 12, batch_size=16)
+    sink = AggregatorSink(agg, flush_size=16, device_queue_depth=0)
+    assert sink.verifier is None
+    assert not agg.verify_counts()
+    assert not agg.drain().verified
+
+
+def test_checkpoint_roundtrip(serial_run, tmp_path):
+    _pairs, expect, agg, _sink = serial_run
+    path = str(tmp_path / "agg.npz")
+    agg.save_checkpoint(path)
+    h = HostSnapshotAggregator(capacity=1 << 10)
+    h.load_checkpoint(path)
+    assert np.array_equal(h.verify_verified, agg.verify_verified)
+    snap = h.drain()
+    assert sum(snap.verified.values()) == expect["verified"]
+    assert sum(snap.failed.values()) == expect["failed"]
+    # Pre-round-13 snapshots (no verify arrays) load as zeros.
+    z = dict(np.load(path, allow_pickle=True))
+    z.pop("verify_verified")
+    z.pop("verify_failed")
+    legacy = str(tmp_path / "legacy.npz")
+    with open(legacy, "wb") as fh:
+        np.savez_compressed(fh, **z)
+    h2 = HostSnapshotAggregator(capacity=1 << 10)
+    h2.load_checkpoint(legacy)
+    assert not h2.verify_counts()
+    assert not h2.drain().verified
+
+
+def test_issuer_meta_carries_verify_counts(serial_run):
+    from ct_mapreduce_tpu.serve.server import MembershipOracle
+
+    _pairs, expect, agg, _sink = serial_run
+    oracle = MembershipOracle(agg, replicas=1, device=False,
+                              cache_size=-1)
+    try:
+        iss_id = next(iter(agg.verify_counts()))
+        meta = oracle.issuer_meta(iss_id)
+        assert meta["verified"] == expect["verified"]
+        assert meta["failed"] == expect["failed"]
+    finally:
+        oracle.close()
+
+
+def test_storage_statistics_verify_totals(serial_run, tmp_path):
+    import io
+    import json
+
+    from ct_mapreduce_tpu.cmd import storage_statistics as stats
+    from ct_mapreduce_tpu.config import CTConfig
+
+    _pairs, expect, agg, _sink = serial_run
+    path = str(tmp_path / "agg.npz")
+    agg.save_checkpoint(path)
+    cfg = CTConfig()
+    cfg.backend = "tpu"
+    cfg.agg_state_path = path
+    out = io.StringIO()
+    assert stats.report_from_tpu_snapshot(cfg, out) == 0
+    text = out.getvalue()
+    assert f"{expect['verified']} scts verified" in text
+    assert f"{expect['failed']} scts failed" in text
+    report = stats.collect_tpu_report(cfg)
+    assert report["totals"]["sctsVerified"] == expect["verified"]
+    assert report["totals"]["sctsFailed"] == expect["failed"]
+    json.dumps(report)  # stays serializable
+
+
+def test_resolve_verify_env_layering(monkeypatch):
+    monkeypatch.delenv("CTMR_VERIFY", raising=False)
+    monkeypatch.delenv("CTMR_VERIFY_KEYS", raising=False)
+    monkeypatch.delenv("CTMR_VERIFY_BATCH", raising=False)
+    assert resolve_verify() == (False, "", 1024)
+    monkeypatch.setenv("CTMR_VERIFY", "1")
+    monkeypatch.setenv("CTMR_VERIFY_KEYS", "/tmp/k.json")
+    monkeypatch.setenv("CTMR_VERIFY_BATCH", "256")
+    assert resolve_verify() == (True, "/tmp/k.json", 256)
+    # explicit beats env; junk batch env is ignored
+    monkeypatch.setenv("CTMR_VERIFY_BATCH", "zap")
+    assert resolve_verify(False, "x.json", 64) == (False, "x.json", 64)
+    assert resolve_verify(True) == (True, "/tmp/k.json", 1024)
+
+
+def test_sink_loads_keys_from_file(tmp_path):
+    reg = LogKeyRegistry()
+    p256, p384, rsa = _signers()
+    for s in (p256, p384, rsa):
+        reg.register_signer(s)
+    keys_path = tmp_path / "keys.json"
+    keys_path.write_text(reg.to_json())
+    agg = TpuAggregator(capacity=1 << 12, batch_size=16)
+    sink = AggregatorSink(agg, flush_size=16, device_queue_depth=0,
+                          verify_signatures=True,
+                          verify_log_keys=str(keys_path))
+    assert isinstance(sink.verifier, SignatureVerifier)
+    assert len(sink.verifier.keys) == 3
+    assert sink.verifier.keys.is_p256(p256.log_id)

@@ -35,6 +35,15 @@ device-value read. Stage deltas then give real per-stage costs:
             The wall delta across K is the per-dispatch toll that
             staging amortizes (CT_SC_DISPATCH_B overrides the chunk
             lane count).
+  verify  — the batched ECDSA-P256 verification kernel
+            (ops/ecdsa.verify_p256) at B ∈ {256, 1024, 4096}:
+            ns/signature per batch width on a mixed valid/invalid
+            corpus, verdict parity asserted against the pure-python
+            host verifier at every width. The curve is the
+            amortized-dispatch story — per-op overhead inside the
+            256-bit ladder is fixed per op, so wider batches spread
+            it over more lanes (CT_SC_VERIFY_B overrides the width
+            list, comma-separated).
 
 Run:  python tools/stagecost.py [batch] [stage ...]
 """
@@ -399,6 +408,74 @@ def main() -> None:
                 f"{per_chunk / b * 1e9:8.1f} ns/entry  "
                 f"({base[2] / best:.2f}x vs K=1, parity exact)")
 
+    def run_verify():
+        """Device ns/signature vs batch width, host-parity asserted.
+
+        Methodology matches the headline: jitted kernel, warmup run
+        (compile excluded), best-of-3 timed runs each ending in the
+        synchronous verdict readback. The corpus tiles 64 unique
+        signatures (3/4 valid, 1/4 mutated) so host-side generation
+        stays cheap at B=4096; parity is asserted lane-by-lane."""
+        import hashlib
+
+        from ct_mapreduce_tpu.ops import ecdsa
+        from ct_mapreduce_tpu.verify import host as vhost
+
+        widths = [int(w) for w in os.environ.get(
+            "CT_SC_VERIFY_B", "256,1024,4096").split(",")]
+        c = vhost.P256
+        uniq = []
+        for i in range(64):
+            seed = f"sc-{i % 7}"
+            d = vhost.derive_scalar(seed)
+            q = vhost._point_mul(c, d, (c.gx, c.gy))
+            digest = hashlib.sha256(b"sc%d" % i).digest()
+            k = vhost.derive_nonce(seed, digest)
+            r, s_ = vhost.sign_ecdsa(c, digest, d, k)
+            if i % 4 == 0:
+                s_ ^= 1 << (i % 250)  # mutated lane
+            uniq.append((digest, r, s_, q[0], q[1]))
+        href = [vhost.verify_ecdsa(c, dg, r, s_, x, y)
+                for dg, r, s_, x, y in uniq]
+
+        def b32(v):
+            return np.frombuffer((v % (1 << 256)).to_bytes(32, "big"),
+                                 np.uint8)
+
+        rows = {
+            "digest": np.stack([np.frombuffer(u[0], np.uint8)
+                                for u in uniq]),
+            "r": np.stack([b32(u[1]) for u in uniq]),
+            "s": np.stack([b32(u[2]) for u in uniq]),
+            "qx": np.stack([b32(u[3]) for u in uniq]),
+            "qy": np.stack([b32(u[4]) for u in uniq]),
+        }
+        base_ns = None
+        for w in widths:
+            reps = -(-w // 64)
+            args = [np.tile(rows[k], (reps, 1))[:w]
+                    for k in ("digest", "r", "s", "qx", "qy")]
+            valid = np.ones((w,), bool)
+            t0 = time.perf_counter()
+            out = np.asarray(ecdsa.verify_p256_jit(*args, valid))
+            say(f"  verify B={w}: compile+warmup "
+                f"{time.perf_counter() - t0:.1f}s")
+            expect = (href * reps)[:w]
+            assert out.tolist() == expect, f"verify B={w}: parity"
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = np.asarray(ecdsa.verify_p256_jit(*args, valid))
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            assert out.tolist() == expect
+            ns = best / w * 1e9
+            if base_ns is None:
+                base_ns = ns
+            say(f"verify  B={w:<5d} {best * 1e3:9.2f} ms/batch  "
+                f"{ns:12.1f} ns/sig  ({base_ns / ns:.2f}x vs "
+                f"B={widths[0]}, parity exact)")
+
     stages = [
         ("read", s_read), ("pack", s_pack), ("pack2", s_pack2),
         ("parse", s_parse),
@@ -412,6 +489,10 @@ def main() -> None:
     if not only or "dispatch" in only:
         run_dispatch()
     if only == {"dispatch"}:
+        return
+    if not only or "verify" in only:
+        run_verify()
+    if only == {"verify"}:
         return
     for name, fn in stages:
         if only and name not in only:
