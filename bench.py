@@ -1891,6 +1891,192 @@ def run_verify_smoke() -> dict:
     }
 
 
+def run_fleet_smoke() -> dict:
+    """CT_BENCH_SMOKE fleet leg (round 14): W ∈ {1, 2} local ct-fetch
+    worker PROCESSES over a shared fakelog fixture, coordinated
+    through miniredis (election + barrier + checkpoint epochs), with:
+
+      (1) parity EXACT: each fleet's merged per-worker aggregate is
+          byte-identical (serial counts per (issuer, expDate), CRL/DN
+          metadata) to a serial single-process run of the same
+          entries;
+      (2) partition structure: the rendezvous map is disjoint and
+          covering, and under W=2 both workers own work;
+      (3) aggregate throughput recorded honestly: on this 1-core CI
+          box the W processes share one core, so the aggregate
+          entries/s number carries NO scaling claim — the parity +
+          structure gates carry it (the rounds-11/12 convention);
+          real scaling needs a multi-core/multi-host run.
+    """
+    import tempfile
+
+    if os.environ.get("CT_TPU_TESTS", "") == "":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tools import fleet as harness
+
+    from ct_mapreduce_tpu.ingest.fleet import partition_map
+    from ct_mapreduce_tpu.utils.miniredis import MiniRedis
+
+    state_dir = tempfile.mkdtemp(prefix="ct-fleet-smoke-")
+    fixture_path = os.path.join(state_dir, "fixture.json")
+    fixture = harness.build_fixture(
+        fixture_path, n_logs=2, entries_per_log=64, dupes=6, max_batch=64)
+    urls = list(fixture["logs"])
+    total = sum(len(v) for v in fixture["logs"].values())
+
+    # Serial truth, in-process (this interpreter's jax is warm).
+    ref = harness.run_serial_reference(fixture, state_dir)
+    if ref["total"] <= 0:
+        raise BenchError("serial reference ingested nothing")
+
+    # The W=2 partition map must be disjoint+covering with work on
+    # both sides before any process spawns.
+    owners = partition_map(urls, 2)
+    if sorted(owners) != sorted(urls) or set(owners.values()) != {0, 1}:
+        raise BenchError(f"degenerate W=2 partition: {owners}")
+
+    results = {}
+
+    # W=1 leg IN-PROCESS: this interpreter IS the single fleet worker
+    # (numWorkers=1 over a redis coordinator — the full election/
+    # epoch/checkpoint machinery runs, without paying a process spawn
+    # + jax import on the 1-core box).
+    from ct_mapreduce_tpu.agg.aggregator import HostSnapshotAggregator
+    from ct_mapreduce_tpu.cmd import ct_fetch
+    from ct_mapreduce_tpu.ingest import ctclient
+
+    import json as _json
+    import socket as _socket
+    import threading as _threading
+    import urllib.request as _urlreq
+
+    redis = MiniRedis().start()
+    orig_transport = ctclient._urllib_transport
+    try:
+        # Throttled small batches pace the W=1 run past a few 150 ms
+        # checkpoint epochs, so the /healthz poller below observes the
+        # live fleet section (role, membership, partition, epoch).
+        paced = harness.FixtureTransport(fixture, throttle_ms=150)
+        paced.max_batch = 16
+        ctclient._urllib_transport = paced
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        mport = s.getsockname()[1]
+        s.close()
+        w1_dir = os.path.join(state_dir, "f1-w0")
+        os.makedirs(w1_dir, exist_ok=True)
+        w1_ini = os.path.join(w1_dir, "worker.ini")
+        w1_state = os.path.join(w1_dir, "agg.npz")
+        harness.write_worker_ini(
+            w1_ini, fixture, w1_state, redis_addr=redis.address,
+            checkpoint_period="150ms", coordinator="redis")
+        with open(w1_ini, "a") as fh:
+            fh.write(f"metricsPort = {mport}\n")
+        fleet_bodies = []
+        poll_stop = _threading.Event()
+
+        def poll_healthz():
+            while not poll_stop.is_set():
+                try:
+                    with _urlreq.urlopen(
+                            f"http://127.0.0.1:{mport}/healthz",
+                            timeout=1) as resp:
+                        body = _json.loads(resp.read())
+                    if "fleet" in body:
+                        fleet_bodies.append(body["fleet"])
+                except Exception:
+                    pass
+                time.sleep(0.05)
+
+        poller = _threading.Thread(target=poll_healthz, daemon=True)
+        poller.start()
+        t0 = time.monotonic()
+        rc = ct_fetch.main(["-config", w1_ini, "-nobars"])
+        wall = time.monotonic() - t0
+        poll_stop.set()
+        poller.join(5)
+    finally:
+        ctclient._urllib_transport = orig_transport
+        redis.stop()
+    if rc != 0:
+        raise BenchError(f"fleet W=1 worker rc={rc}")
+    agg1 = HostSnapshotAggregator(capacity=1 << 10)
+    agg1.load_checkpoint(w1_state)
+    if harness.snapshot_jsonable(agg1.drain()) != ref:
+        raise BenchError("fleet W=1 aggregate diverged from serial run")
+    # The /healthz fleet section served live: worker role, full
+    # membership, the rendezvous partition map, and >=1 leader-
+    # published checkpoint epoch observed mid-run.
+    if not fleet_bodies:
+        raise BenchError("no /healthz body carried the fleet section")
+    last_fleet = fleet_bodies[-1]
+    if last_fleet["role"] != "leader" or last_fleet["workers_alive"] != [0]:
+        raise BenchError(f"W=1 fleet healthz wrong: {last_fleet}")
+    part = next((f["partition"] for f in fleet_bodies if f["partition"]),
+                None)
+    if part is None or set(part) != set(urls) or set(part.values()) != {0}:
+        raise BenchError(f"W=1 partition map not surfaced: {part}")
+    if not any(f.get("checkpoint_epoch", 0) >= 1 for f in fleet_bodies):
+        raise BenchError("no checkpoint epoch observed in /healthz")
+    results[1] = {"wall_s": wall, "entries_per_s": total / wall,
+                  "healthz_epoch": max(f.get("checkpoint_epoch", 0)
+                                       for f in fleet_bodies)}
+    log(f"fleet smoke W=1: parity exact, healthz fleet section live "
+        f"(epoch {results[1]['healthz_epoch']}), "
+        f"{total / wall:,.0f} entries/s (in-process, wall {wall:.1f}s)")
+
+    # W=2 leg: two real worker PROCESSES over miniredis.
+    redis = MiniRedis().start()
+    try:
+        t0 = time.monotonic()
+        procs = [
+            harness.spawn_worker(
+                w, 2, fixture_path,
+                os.path.join(state_dir, f"f2-w{w}"),
+                redis.address, checkpoint_period="500ms",
+                coordinator="redis")
+            for w in range(2)
+        ]
+        outs = [p.communicate(timeout=420)[0] for p in procs]
+        wall = time.monotonic() - t0
+    finally:
+        redis.stop()
+    for w, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise BenchError(
+                f"fleet W=2 worker {w} rc={p.returncode}: {out[-1500:]}")
+    dones = [next(e for e in harness.child_events(out)
+                  if e["event"] == "done") for out in outs]
+    owned = {d["worker"]: d["owned_logs"] for d in dones}
+    flat = [u for logs in owned.values() for u in logs]
+    if sorted(flat) != sorted(urls):
+        raise BenchError(f"W=2 partition not disjoint+covering: {owned}")
+    if not all(owned.values()):
+        raise BenchError(f"W=2 worker with empty partition: {owned}")
+    merged = harness.merged_snapshot([d["state_path"] for d in dones])
+    if merged != ref:
+        raise BenchError(
+            f"fleet W=2 merged aggregate diverged from the serial run: "
+            f"merged {merged['total']} vs ref {ref['total']}")
+    results[2] = {"wall_s": wall, "entries_per_s": total / wall}
+    log(f"fleet smoke W=2: parity exact, {total / wall:,.0f} entries/s "
+        f"aggregate (wall {wall:.1f}s, 1-core box — no scaling claim)")
+
+    return {
+        "metric": "ct_fleet_smoke",
+        "value": results[2]["entries_per_s"],
+        "unit": "entries/s",
+        "smoke_fleet_entries": total,
+        "smoke_fleet_parity": 1,
+        "smoke_fleet_w1_wall_s": results[1]["wall_s"],
+        "smoke_fleet_w2_wall_s": results[2]["wall_s"],
+        "smoke_fleet_w1_entries_per_s": results[1]["entries_per_s"],
+        "smoke_fleet_w2_entries_per_s": results[2]["entries_per_s"],
+        "smoke_fleet_healthz_epoch": results[1]["healthz_epoch"],
+        "smoke_fleet_ref_total": ref["total"],
+    }
+
+
 def smoke_main() -> int:
     try:
         payload = run_smoke()

@@ -103,6 +103,19 @@ class IssuerRegistry:
             self._by_der[issuer_der] = idx
             return idx
 
+    def assign_issuer(self, issuer: Issuer) -> int:
+        """Index for an already-constructed :class:`Issuer` identity
+        (no DER in hand — e.g. folding another worker's checkpointed
+        registry into a merged view)."""
+        with self._lock:
+            iid = issuer.id()
+            idx = self._by_issuer_id.get(iid)
+            if idx is None:
+                idx = len(self._issuers)
+                self._issuers.append(issuer)
+                self._by_issuer_id[iid] = idx
+            return idx
+
     def index_of_issuer_id(self, issuer_id: str) -> Optional[int]:
         return self._by_issuer_id.get(issuer_id)
 
@@ -472,6 +485,13 @@ class TpuAggregator:
         # checkpoint read racing a submit would touch a deleted array.
         # Lock order where both are held: _fold_lock, then _table_lock.
         self._table_lock = threading.RLock()
+        # Serializes whole checkpoint writes: the fleet cadence thread
+        # (ingest/fleet.py epoch ticks) and the run's own save path can
+        # both reach save_checkpoint; interleaved writers are each
+        # atomic (temp + rename) but doing the drain + serialize work
+        # twice concurrently is waste and widens buffer-lifetime
+        # exposure for no benefit.
+        self._save_lock = threading.Lock()
         self.table = self._make_table(capacity)
         # Bucket tables round capacity up to whole buckets; load-factor
         # arithmetic must use the real slot count.
@@ -1677,25 +1697,27 @@ class TpuAggregator:
         otherwise silently append ``.npz``, breaking the resume and
         --backend=tpu lookups that check the bare path.
         """
-        self.complete_outstanding()
-        host_items = [
-            (idx, eh, b";".join(s.hex().encode() for s in sorted(serials)))
-            for (idx, eh), serials in self.host_serials.items()
-        ]
-        directory = os.path.dirname(os.path.abspath(path))
-        fd, tmp_path = tempfile.mkstemp(
-            prefix=os.path.basename(path) + ".tmp.", dir=directory
-        )
-        try:
-            with os.fdopen(fd, "wb") as fh:
-                self._write_npz(fh, host_items)
-                fh.flush()
-                os.fsync(fh.fileno())
-            os.replace(tmp_path, path)
-        except BaseException:
-            with contextlib.suppress(OSError):
-                os.unlink(tmp_path)
-            raise
+        with self._save_lock:
+            self.complete_outstanding()
+            host_items = [
+                (idx, eh, b";".join(s.hex().encode()
+                                    for s in sorted(serials)))
+                for (idx, eh), serials in self.host_serials.items()
+            ]
+            directory = os.path.dirname(os.path.abspath(path))
+            fd, tmp_path = tempfile.mkstemp(
+                prefix=os.path.basename(path) + ".tmp.", dir=directory
+            )
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    self._write_npz(fh, host_items)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp_path, path)
+            except BaseException:
+                with contextlib.suppress(OSError):
+                    os.unlink(tmp_path)
+                raise
 
     def _write_npz(self, fh, host_items) -> None:
         layout = ("bucket" if isinstance(self.table, buckettable.BucketTable)
@@ -1703,9 +1725,15 @@ class TpuAggregator:
         # ONE device fetch for the whole table: the .keys/.meta
         # properties each pull rows through the tunnel (~0.5s per
         # 64 MB D2H), so going through them would double checkpoint
-        # readback cost for multi-GB tables.
+        # readback cost for multi-GB tables. Materialized as a
+        # HOST-OWNED copy under the table lock — np.asarray of a
+        # CPU-backend jax array is a zero-copy VIEW of the XLA buffer,
+        # and the long savez_compressed window below must not read
+        # device memory whose lifetime it doesn't own (table swaps and
+        # donation policies are backend-dependent); the copy bounds
+        # the exposure to a memcpy made while swaps are locked out.
         with self._table_lock:
-            rows = np.asarray(self.table.rows)
+            rows = np.array(self.table.rows, copy=True)
         if layout == "bucket":
             slots = rows[:, : buckettable.SLOTS * 5].reshape(-1, 5)
         else:

@@ -1,0 +1,126 @@
+"""Merging per-worker fleet aggregates into one reduce view.
+
+A multi-worker ingest fleet (ingest/fleet.py) leaves one aggregate
+checkpoint per worker. Each worker's own :meth:`drain` already
+resolves its internal host/device dedup overlap with a consistent
+issuer indexing, so the fleet-level merge is the MapReduce reduce-side
+union over those drained snapshots:
+
+- per-(issuer, expDate) serial **counts sum** — partitions are
+  disjoint over entries by the rendezvous partitioner, so no entry is
+  counted twice;
+- per-issuer CRL/DN metadata and host-lane serial bytes **set-union**
+  (idempotent, so checkpoint-replayed tails merge cleanly);
+- verify verdict counts sum.
+
+Honest limit: a certificate *identity* cross-logged into two logs
+owned by DIFFERENT workers counts once per owning worker here (their
+device tables hold 128-bit fingerprints under worker-local issuer
+indices — not comparable across workers), where the reference's single
+global Redis SADD — and this repo's single-job mesh-sharded mode —
+would count it once. Exact global dedup across partitions needs the
+shared-table modes; the fleet trades that for N× feed throughput.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+from ct_mapreduce_tpu.agg.aggregator import (
+    AggregateSnapshot,
+    HostSnapshotAggregator,
+    IssuerRegistry,
+)
+
+
+def expand_state_paths(spec: str) -> list[str]:
+    """``aggStatePath`` → concrete snapshot paths: comma-separated
+    entries, each optionally a glob (``agg.w*.npz``). Non-glob entries
+    pass through even when absent (the caller reports the miss); glob
+    entries expand to what exists, sorted for determinism."""
+    paths: list[str] = []
+    for part in (p.strip() for p in spec.split(",")):
+        if not part:
+            continue
+        if any(ch in part for ch in "*?["):
+            paths.extend(sorted(glob.glob(part)))
+        else:
+            paths.append(part)
+    return paths
+
+
+def merge_snapshots(snaps) -> AggregateSnapshot:
+    """Reduce-side union of drained per-worker snapshots: counter sum
+    + metadata set-union."""
+    counts: dict[tuple[str, str], int] = {}
+    crls: dict[str, set[str]] = {}
+    dns: dict[str, set[str]] = {}
+    verified: dict[str, int] = {}
+    failed: dict[str, int] = {}
+    for snap in snaps:
+        for key, n in snap.counts.items():
+            counts[key] = counts.get(key, 0) + n
+        for iss, urls in snap.crls.items():
+            crls.setdefault(iss, set()).update(urls)
+        for iss, names in snap.dns.items():
+            dns.setdefault(iss, set()).update(names)
+        for iss, n in snap.verified.items():
+            verified[iss] = verified.get(iss, 0) + n
+        for iss, n in snap.failed.items():
+            failed[iss] = failed.get(iss, 0) + n
+    return AggregateSnapshot(
+        counts=counts, crls=crls, dns=dns, total=sum(counts.values()),
+        verified=verified, failed=failed,
+    )
+
+
+class MergedAggregate:
+    """A fleet's worth of worker checkpoints presented through the
+    surface ``storage-statistics`` reads from a single aggregator:
+    ``drain()`` (the merged snapshot), ``registry`` (union issuer
+    indexing), and ``host_serials`` (worker-local indices remapped
+    into it, serial byte-sets unioned)."""
+
+    def __init__(self) -> None:
+        self.registry = IssuerRegistry()
+        self.host_serials: dict[tuple[int, int], set[bytes]] = {}
+        self._snapshots: list[AggregateSnapshot] = []
+        self.worker_paths: list[str] = []
+
+    def fold_checkpoint(self, path: str) -> None:
+        """Load one worker's ``.npz`` checkpoint, drain it through the
+        worker's own exact fold path, and union the results in."""
+        agg = HostSnapshotAggregator(capacity=1 << 10)
+        agg.load_checkpoint(path)
+        self._snapshots.append(agg.drain())
+        self.worker_paths.append(path)
+        remap = {
+            idx: self.registry.assign_issuer(agg.registry.issuer_at(idx))
+            for idx in range(len(agg.registry))
+        }
+        for (idx, eh), serials in agg.host_serials.items():
+            key = (remap[idx], eh)
+            self.host_serials.setdefault(key, set()).update(serials)
+
+    def drain(self) -> AggregateSnapshot:
+        return merge_snapshots(self._snapshots)
+
+
+def load_checkpoints(paths) -> MergedAggregate:
+    """Fold every worker checkpoint into one merged view."""
+    merged = MergedAggregate()
+    for path in paths:
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        merged.fold_checkpoint(path)
+    return merged
+
+
+__all__ = [
+    "AggregateSnapshot",
+    "MergedAggregate",
+    "expand_state_paths",
+    "load_checkpoints",
+    "merge_snapshots",
+]

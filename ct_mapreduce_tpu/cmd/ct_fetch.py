@@ -13,12 +13,19 @@ per-entry Redis round-trips; device aggregates snapshot to
 
 from __future__ import annotations
 
+import contextlib
 import signal
 import sys
 import threading
 import time
 from ct_mapreduce_tpu.config import CTConfig
 from ct_mapreduce_tpu.engine import get_configured_storage, prepare_telemetry
+from ct_mapreduce_tpu.ingest.fleet import (
+    FleetService,
+    build_coordinator,
+    resolve_fleet,
+    worker_state_path,
+)
 from ct_mapreduce_tpu.ingest.health import HealthServer
 from ct_mapreduce_tpu.ingest.sync import (
     AggregatorSink,
@@ -116,6 +123,33 @@ def build_sink(config: CTConfig, database, backend=None):
     return sink, None
 
 
+def fleet_assignments(fleet, log_urls: list[str],
+                      takeover: bool = False) -> list[tuple]:
+    """This worker's share of the feed as (url, offset, limit,
+    state_suffix) download assignments. Multi-log fleets partition
+    whole logs by rendezvous hash; a fleet pointed at ONE log stripes
+    its entry-index space instead (one STH fetch resolves the tree
+    size), each stripe with its own durable cursor key."""
+    if fleet is None:
+        return [(u, None, None, "") for u in log_urls]
+    if fleet.num_workers <= 1:
+        # Degenerate fleet: worker 0 owns everything, but the map
+        # still computes so /healthz surfaces it.
+        return [(u, None, None, "") for u in fleet.partition(log_urls)]
+    if len(log_urls) == 1:
+        from ct_mapreduce_tpu.ingest.ctclient import CTLogClient
+
+        url = log_urls[0]
+        tree_size = CTLogClient(url).get_sth().tree_size
+        offset, limit = fleet.stripe(tree_size)
+        fleet.note_stripe(url, offset, limit)
+        if limit <= 0:
+            return []  # more workers than entries: nothing for us
+        return [(url, offset, limit, f"#w{fleet.worker_id}")]
+    return [(u, None, None, "")
+            for u in fleet.partition(log_urls, takeover=takeover)]
+
+
 def main(argv: list[str] | None = None) -> int:
     config = CTConfig.load(argv)
     log_urls = config.log_urls()
@@ -123,6 +157,20 @@ def main(argv: list[str] | None = None) -> int:
         print(config.usage(), file=sys.stderr)
         print("\nerror: logList is required", file=sys.stderr)
         return 2
+
+    # Fleet resolution before any state path is used: each worker of a
+    # multi-worker ingest keeps its own aggregate snapshot
+    # (agg.npz → agg.w<id>.npz); storage-statistics merges them
+    # (aggStatePath glob) into one view.
+    num_workers, fleet_worker_id, checkpoint_period, coord_backend = (
+        resolve_fleet(config.num_workers, config.worker_id,
+                      config.checkpoint_period, config.coordinator_backend))
+    if fleet_worker_id >= num_workers:
+        print(f"error: workerId {fleet_worker_id} outside "
+              f"[0, numWorkers={num_workers})", file=sys.stderr)
+        return 2
+    config.agg_state_path = worker_state_path(
+        config.agg_state_path, fleet_worker_id, num_workers)
 
     database, _cache, _backend = get_configured_storage(config)  # noqa: F841
     dumper = prepare_telemetry("ct-fetch", config)
@@ -166,6 +214,29 @@ def main(argv: list[str] | None = None) -> int:
     )
     engine.start_store_threads()
 
+    # Fleet lifecycle (ingest/fleet.py): leader election + start
+    # barrier + heartbeats over the configured coordination fabric
+    # (the RemoteCache for `redis`, jax.distributed for `jax`), with
+    # the leader publishing checkpoint-cadence epochs every
+    # `checkpointPeriod` — each worker checkpoints (aggregate snapshot
+    # + cursors) when it observes the epoch advance — and a clean-
+    # shutdown broadcast that stops every worker's downloaders.
+    fleet = None
+    if num_workers > 1 or coord_backend or checkpoint_period:
+        coordinator = build_coordinator(
+            coord_backend, _cache, "ct-fetch", fleet_worker_id, num_workers)
+        fleet = FleetService(
+            coordinator,
+            checkpoint_period_s=(parse_duration(checkpoint_period)
+                                 if checkpoint_period else 0.0),
+            on_checkpoint=lambda epoch: engine.checkpoint_now(),
+            on_shutdown=lambda reason: (
+                print(f"\nfleet shutdown broadcast: {reason}",
+                      file=sys.stderr),
+                engine.signal_stop(),
+            ),
+        )
+
     health = None
     if config.health_addr:
         try:
@@ -195,6 +266,8 @@ def main(argv: list[str] | None = None) -> int:
             body["overlap_queues"] = ovl.queue_depths()
         if query_server is not None:
             body["serve"] = query_server.oracle.stats()
+        if fleet is not None:
+            body["fleet"] = fleet.stats()
         return body
 
     # Query plane: the batched membership-oracle JSON API over the live
@@ -238,6 +311,10 @@ def main(argv: list[str] | None = None) -> int:
             # Orchestrator kill: leave the post-mortem artifact before
             # draining (the drain itself may be what's wedged).
             flight.dump(f"signal {signum} (SIGTERM)")
+        if fleet is not None and fleet.is_leader:
+            # Leader-published clean shutdown: followers observe the
+            # broadcast and drain too, so one signal stops the fleet.
+            fleet.request_shutdown(f"leader signal {signum}")
         engine.signal_stop()
 
     def handle_dump_signal(signum, frame):
@@ -245,10 +322,18 @@ def main(argv: list[str] | None = None) -> int:
         print(f"\nsignal {signum}: flight record "
               f"{path or 'not written'}", file=sys.stderr)
 
-    signal.signal(signal.SIGINT, handle_signal)
-    signal.signal(signal.SIGTERM, handle_signal)
+    # Previous handlers are restored in the finally below — main() must
+    # leave no global hooks behind (same contract as the flight
+    # recorder's excepthook note above): tests and runForever wrappers
+    # re-enter it, and a stale handler would swallow a later SIGTERM
+    # meant for the host process.
+    prev_handlers = {}
+    for signum, handler in ((signal.SIGINT, handle_signal),
+                            (signal.SIGTERM, handle_signal)):
+        prev_handlers[signum] = signal.signal(signum, handler)
     try:
-        signal.signal(signal.SIGUSR1, handle_dump_signal)
+        prev_handlers[signal.SIGUSR1] = signal.signal(
+            signal.SIGUSR1, handle_dump_signal)
     except (AttributeError, ValueError, OSError):
         pass  # platform without SIGUSR1 / non-main thread
 
@@ -272,11 +357,27 @@ def main(argv: list[str] | None = None) -> int:
             print(f"profiling disabled: {err}", file=sys.stderr)
 
     final_round_errors = False
+    sync_round = 0
     try:
+        if fleet is not None:
+            # Election + start barrier: every worker begins its
+            # partition at once, like the reference's Redis barrier
+            # (and nobody fetches before the fleet is fully present).
+            run_stage["stage"] = "electing"
+            role = fleet.start(timeout_s=600.0)
+            print(f"fleet worker {fleet.worker_id}/{num_workers} "
+                  f"({'leader' if role else 'follower'}, "
+                  f"coordinator={type(fleet.coordinator).__name__})",
+                  file=sys.stderr)
         while True:
             run_stage["stage"] = "syncing"
-            for url in log_urls:
-                engine.sync_log(url)
+            # Dead-owner takeover only on later runForever rounds: the
+            # start barrier guaranteed full membership for round 0.
+            for url, f_off, f_lim, f_sfx in fleet_assignments(
+                    fleet, log_urls, takeover=sync_round > 0):
+                engine.sync_log(url, offset=f_off, limit=f_lim,
+                                state_suffix=f_sfx)
+            sync_round += 1
             engine.wait_for_downloads()
             run_stage["stage"] = "draining"
             engine.stop()  # drain queue, flush sink
@@ -291,6 +392,8 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"error: {e}", file=sys.stderr)
             engine.errors.clear()
             if not config.run_forever or engine.stop_event.is_set():
+                break
+            if fleet is not None and fleet.shutdown_requested():
                 break
             engine.start_store_threads()  # next round
             delay = polling_delay(
@@ -323,6 +426,8 @@ def main(argv: list[str] | None = None) -> int:
             metrics_server.stop()
         if query_server:
             query_server.stop()
+        if fleet is not None:
+            fleet.stop()
         if dumper:
             dumper.stop()
         if trace.enabled():
@@ -330,6 +435,9 @@ def main(argv: list[str] | None = None) -> int:
             if path:
                 print(f"trace written to {path}", file=sys.stderr)
         flight.uninstall()
+        for signum, prev in prev_handlers.items():
+            with contextlib.suppress(ValueError, OSError):
+                signal.signal(signum, prev)
         engine.cleanup()
     return 1 if final_round_errors else 0
 

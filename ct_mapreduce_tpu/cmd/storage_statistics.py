@@ -20,6 +20,28 @@ from ct_mapreduce_tpu.config import CTConfig
 from ct_mapreduce_tpu.engine import get_configured_storage, prepare_telemetry
 
 
+def _load_tpu_aggregate(config: CTConfig):
+    """``aggStatePath`` → an aggregate view, or None when nothing is
+    there. One path loads the host-only snapshot reader; several
+    (comma list and/or glob — a fleet's per-worker ``agg.w*.npz``
+    checkpoints, ingest/fleet.py) fold into a
+    :class:`~ct_mapreduce_tpu.agg.merge.MergedAggregate`, so one
+    storage-statistics run reports the whole fleet."""
+    import os
+
+    from ct_mapreduce_tpu.agg import merge
+    from ct_mapreduce_tpu.agg.aggregator import HostSnapshotAggregator
+
+    paths = merge.expand_state_paths(config.agg_state_path)
+    if not paths or any(not os.path.exists(p) for p in paths):
+        return None
+    if len(paths) == 1:
+        agg = HostSnapshotAggregator(capacity=1 << 10)
+        agg.load_checkpoint(paths[0])
+        return agg
+    return merge.load_checkpoints(paths)
+
+
 def report_from_tpu_snapshot(config: CTConfig, out, verbosity: int = 0) -> int:
     """Drain path: aggregate snapshot → the same report shape.
 
@@ -35,24 +57,20 @@ def report_from_tpu_snapshot(config: CTConfig, out, verbosity: int = 0) -> int:
     but not serial-listable BY DESIGN (SURVEY §7 layer 2c); without a
     certPath tree they are reported as counts only.
     """
-    import os
-
-    from ct_mapreduce_tpu.agg.aggregator import HostSnapshotAggregator
     from ct_mapreduce_tpu.core.types import ExpDate, Serial
 
-    path = config.agg_state_path
-    if not path or not os.path.exists(path):
+    # Host-only snapshot reader: the report is pure host work, so it
+    # must not allocate device buffers or wait on TPU acquisition
+    # (reports must stay runnable during pool outages). A multi-path
+    # aggStatePath (fleet) folds per-worker checkpoints into one view.
+    agg = _load_tpu_aggregate(config)
+    if agg is None:
         print(
-            f"error: aggStatePath not found: {path!r} "
+            f"error: aggStatePath not found: {config.agg_state_path!r} "
             "(run ct-fetch with backend=tpu first)",
             file=out,
         )
         return 1
-    # Host-only snapshot reader: the report is pure host work, so it
-    # must not allocate device buffers or wait on TPU acquisition
-    # (reports must stay runnable during pool outages).
-    agg = HostSnapshotAggregator(capacity=1 << 10)
-    agg.load_checkpoint(path)
     snap = agg.drain()
 
     backend = None
@@ -198,15 +216,9 @@ def collect_tpu_report(config: CTConfig) -> Optional[dict]:
     the same drain, the same numbers, as a JSON-serializable dict
     (text/JSON parity is pinned by tests/test_cmd.py). Returns None
     when the snapshot is missing (the text path's error case)."""
-    import os
-
-    from ct_mapreduce_tpu.agg.aggregator import HostSnapshotAggregator
-
-    path = config.agg_state_path
-    if not path or not os.path.exists(path):
+    agg = _load_tpu_aggregate(config)
+    if agg is None:
         return None
-    agg = HostSnapshotAggregator(capacity=1 << 10)
-    agg.load_checkpoint(path)
     snap = agg.drain()
 
     by_issuer: dict[str, dict[str, int]] = {}

@@ -85,6 +85,18 @@ class CTConfig:
     verify_log_keys: str = ""  # JSON file of trusted log keys for the
     # verify lane (CTMR_VERIFY_KEYS equivalent; empty = no keys →
     # every SCT counts as verify.no_key)
+    num_workers: int = 0  # fleet size: logs partition across this many
+    # ct-fetch workers by rendezvous hash (0 = CTMR_NUM_WORKERS env,
+    # then 1 = single-worker)
+    worker_id: int = 0  # this worker's id in [0, numWorkers)
+    # (0 = CTMR_WORKER_ID env, then 0)
+    checkpoint_period: str = ""  # leader-published checkpoint cadence
+    # (durable aggregate snapshot + cursors on every epoch tick;
+    # "" = CTMR_CHECKPOINT_PERIOD env, then no fleet cadence — the
+    # per-log savePeriod ticker still runs)
+    coordinator_backend: str = ""  # fleet coordination fabric:
+    # redis | jax | solo ("" = CTMR_COORDINATOR env, then redis when
+    # numWorkers > 1, else solo)
     verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
@@ -131,6 +143,10 @@ class CTConfig:
         "serveCacheSize": ("serve_cache_size", int),
         "verifySignatures": ("verify_signatures", bool),
         "verifyLogKeys": ("verify_log_keys", str),
+        "numWorkers": ("num_workers", int),
+        "workerId": ("worker_id", int),
+        "checkpointPeriod": ("checkpoint_period", str),
+        "coordinatorBackend": ("coordinator_backend", str),
     }
 
     @classmethod
@@ -308,6 +324,19 @@ class CTConfig:
             "counts in reports and /issuer)",
             "verifyLogKeys = JSON file of trusted CT log keys for the "
             "verify lane (CTMR_VERIFY_KEYS equivalent)",
+            "numWorkers = ingest fleet size: CT logs partition across "
+            "this many workers by rendezvous hash; a single-log fleet "
+            "stripes the entry-index space (CTMR_NUM_WORKERS "
+            "equivalent)",
+            "workerId = this worker's id in [0, numWorkers) "
+            "(CTMR_WORKER_ID equivalent)",
+            "checkpointPeriod = leader-published checkpoint cadence: "
+            "every tick, each worker snapshots aggregates + cursors "
+            "atomically for warm restart (CTMR_CHECKPOINT_PERIOD "
+            "equivalent)",
+            "coordinatorBackend = fleet coordination fabric: redis | "
+            "jax | solo (CTMR_COORDINATOR equivalent; default redis "
+            "when numWorkers > 1)",
         ]
         return "\n".join(lines)
 

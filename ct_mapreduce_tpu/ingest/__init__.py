@@ -8,10 +8,17 @@ layout mirrors §3.1-3.3 of SURVEY.md:
   ctclient    CT log v1 HTTP API (get-sth, get-entries×1000, 429 backoff)
   leaf        RFC 6962 TLS-struct decode (MerkleTreeLeaf, chains)
   sync        LogSyncEngine / LogWorker: download → queue → store workers
+  fleet       multi-worker partitioned feed + leader-coordinated lifecycle
   health      /health endpoint (503 before first update, 500 stalled)
 """
 
 from ct_mapreduce_tpu.ingest.ctclient import CTLogClient, SignedTreeHead, short_url
+from ct_mapreduce_tpu.ingest.fleet import (
+    FleetService,
+    partition_logs,
+    partition_map,
+    partition_range,
+)
 from ct_mapreduce_tpu.ingest.leaf import DecodedEntry, decode_entry
 from ct_mapreduce_tpu.ingest.overlap import OverlapError, OverlapIngestPipeline
 from ct_mapreduce_tpu.ingest.sync import LogSyncEngine, LogWorker
@@ -22,8 +29,12 @@ __all__ = [
     "short_url",
     "DecodedEntry",
     "decode_entry",
+    "FleetService",
     "LogSyncEngine",
     "LogWorker",
     "OverlapError",
     "OverlapIngestPipeline",
+    "partition_logs",
+    "partition_map",
+    "partition_range",
 ]

@@ -26,6 +26,7 @@ Two sinks cover the reference path and the TPU path:
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import random
 import threading
@@ -919,23 +920,47 @@ class LogWorker:
         offset: int = 0,
         limit: int = 0,
         pre_save=None,
+        state_suffix: str = "",
     ):
         self.client = client
         self.database = database
         self.pre_save = pre_save  # runs before each durable cursor write
+        # Fleet stripe mode (ingest/fleet.py::partition_range): workers
+        # share one log but own disjoint [offset, offset+limit) index
+        # ranges, so each stripe keeps its OWN durable cursor under
+        # `<short_url><state_suffix>` — a shared cursor would clobber
+        # across workers — and resume takes max(stripe start, saved
+        # cursor) with the stripe END fixed, so a warm restart replays
+        # only the post-checkpoint tail of its own stripe.
+        self.state_suffix = state_suffix
         self.sth = client.get_sth()
-        self.log_state: CertificateLog = database.get_log_state(client.short_url)
-        if offset > 0:
-            self.start_pos = offset
-        else:
-            self.start_pos = self.log_state.max_entry
+        self.log_state: CertificateLog = database.get_log_state(
+            client.short_url + state_suffix)
         tree_end = self.sth.tree_size - 1
-        if limit > 0:
-            self.end_pos = min(self.start_pos + limit - 1, tree_end)
+        if state_suffix:
+            self.start_pos = max(offset, self.log_state.max_entry)
+            self.end_pos = (min(offset + limit - 1, tree_end)
+                            if limit > 0 else tree_end)
         else:
-            self.end_pos = tree_end
+            if offset > 0:
+                self.start_pos = offset
+            else:
+                self.start_pos = self.log_state.max_entry
+            if limit > 0:
+                self.end_pos = min(self.start_pos + limit - 1, tree_end)
+            else:
+                self.end_pos = tree_end
         self.position = self.start_pos
         self.last_entry_time: Optional[datetime] = None
+        # External checkpoint trigger (fleet epoch ticks): the download
+        # loop saves at the next batch boundary when set — same thread
+        # as the periodic ticker saves, so no new concurrency.
+        self._save_signal = threading.Event()
+
+    def request_save(self) -> None:
+        """Ask the download loop to checkpoint (cursor + pre_save
+        aggregate snapshot) at its next batch boundary."""
+        self._save_signal.set()
 
     def save_state(self) -> None:
         """Persist the cursor (ct-fetch.go:371-392): dual-written by
@@ -1029,7 +1054,9 @@ class LogWorker:
                         break
                 if progress is not None:
                     progress(self.client.short_url, self.position, self.end_pos)
-                if time.monotonic() >= next_save:
+                if (self._save_signal.is_set()
+                        or time.monotonic() >= next_save):
+                    self._save_signal.clear()
                     self.save_state()
                     next_save = time.monotonic() + save_period_s
                 continue
@@ -1078,7 +1105,9 @@ class LogWorker:
                 self.position = raw.index + 1
                 if progress is not None:
                     progress(self.client.short_url, self.position, self.end_pos)
-                if time.monotonic() >= next_save:
+                if (self._save_signal.is_set()
+                        or time.monotonic() >= next_save):
+                    self._save_signal.clear()
                     self.save_state()
                     next_save = time.monotonic() + save_period_s
                 if stop.is_set():
@@ -1149,6 +1178,11 @@ class LogSyncEngine:
         # would let other logs' downloaders starve the save indefinitely.
         self._outstanding: dict[str, int] = {}
         self._outstanding_cond = threading.Condition()
+        # Live LogWorkers (fleet checkpoint fan-out): registered for
+        # the duration of their download, so an external checkpoint
+        # tick can ask each to save at its next batch boundary.
+        self._active_workers: list[LogWorker] = []
+        self._active_lock = threading.Lock()
 
     # -- health surface (ct-fetch.go:567-597) ---------------------------
     def last_updates(self) -> dict[str, datetime]:
@@ -1227,17 +1261,45 @@ class LogSyncEngine:
         if self.checkpoint_hook is not None:
             self.checkpoint_hook()
 
+    # -- external checkpoint trigger (fleet epoch ticks) ----------------
+    def checkpoint_now(self) -> None:
+        """Checkpoint the run's durable state out of band: every live
+        downloader saves (cursor + pre_save aggregate snapshot) at its
+        next batch boundary; with no downloads in flight the aggregate
+        snapshot hook runs directly, so idle workers still persist at
+        the fleet's cadence."""
+        with self._active_lock:
+            workers = list(self._active_workers)
+        for worker in workers:
+            worker.request_save()
+        if not workers and self.checkpoint_hook is not None:
+            self.checkpoint_hook()
+
     # -- producers ------------------------------------------------------
-    def sync_log(self, log_url: str, transport=None) -> threading.Thread:
+    def sync_log(self, log_url: str, transport=None,
+                 offset: Optional[int] = None, limit: Optional[int] = None,
+                 state_suffix: str = "") -> threading.Thread:
+        """Start one downloader. ``offset``/``limit`` override the
+        engine-wide window (fleet entry-range stripes of a single log
+        pass their own); ``state_suffix`` keys the stripe's durable
+        cursor (see :class:`LogWorker`)."""
+        eff_offset = self.offset if offset is None else offset
+        eff_limit = self.limit if limit is None else limit
+
         def run() -> None:
+            worker = None
             try:
                 client = CTLogClient(log_url, transport=transport)
                 worker = LogWorker(
-                    client, self.database, offset=self.offset, limit=self.limit,
+                    client, self.database,
+                    offset=eff_offset, limit=eff_limit,
                     # Items carry the client's normalized URL, so the
                     # watermark key must match it.
                     pre_save=lambda: self._pre_cursor_save(client.log_url),
+                    state_suffix=state_suffix,
                 )
+                with self._active_lock:
+                    self._active_workers.append(worker)
                 self._note_progress(client.short_url, worker.position, worker.end_pos)
                 worker.run(
                     _AccountingQueue(self.entry_queue, self._account_enqueued),
@@ -1249,6 +1311,11 @@ class LogSyncEngine:
             except Exception as err:  # log-level failures never kill the run
                 metrics.incr_counter("ct-fetch", "syncLogError")
                 self.errors.append(f"{log_url}: {err}")
+            finally:
+                if worker is not None:
+                    with self._active_lock:
+                        with contextlib.suppress(ValueError):
+                            self._active_workers.remove(worker)
 
         t = threading.Thread(target=run, name=f"sync-{log_url}", daemon=True)
         t.start()

@@ -72,6 +72,48 @@ def _already_initialized() -> bool:
     return distributed.global_state.client is not None
 
 
+def kv_put(key: str, value: str) -> bool:
+    """Publish a value on the jax.distributed coordination service's
+    key-value store (the fleet coordinator's epoch/shutdown fabric on
+    TPU pods). Returns False when no distributed client exists (single
+    process) or the runtime lacks the KV API — callers degrade to
+    local state. Overwrite is emulated by delete-then-set where the
+    runtime forbids re-setting a key."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        return False
+    try:
+        delete = getattr(client, "key_value_delete", None)
+        if delete is not None:
+            try:
+                delete(key)
+            except Exception:
+                pass  # absent key / runtime without delete semantics
+        client.key_value_set(key, value)
+        return True
+    except Exception:
+        return False
+
+
+def kv_get(key: str) -> Optional[str]:
+    """Non-blocking read of a coordination-service KV entry; None when
+    absent, unreadable, or there is no distributed client."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        return None
+    try_get = getattr(client, "key_value_try_get", None)
+    if try_get is None:
+        return None
+    try:
+        return try_get(key)
+    except Exception:
+        return None  # NotFound surfaces as an exception
+
+
 def is_leader() -> bool:
     """Host-0 leadership — the fixed, contention-free analog of winning
     the SETNX election."""

@@ -86,6 +86,18 @@ class RemoteCache(abc.ABC):
         storage/rediscache.go:171-178."""
 
     @abc.abstractmethod
+    def put(self, key: str, value: str,
+            life: Optional[timedelta] = None) -> None:
+        """Unconditional SET, optionally with a TTL. The fleet
+        coordinator's heartbeat/epoch primitives (ingest/fleet.py)
+        need a last-writer-wins value slot — try_set (NX) can only
+        publish a value once per key lifetime."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[str]:
+        """Plain GET; None when absent or expired."""
+
+    @abc.abstractmethod
     def keys_matching(self, pattern: str) -> Iterator[str]:
         """Stream keys matching a glob pattern (SCAN semantics)."""
 

@@ -160,6 +160,21 @@ class MockRemoteCache(RemoteCache):
             self._expirations[key] = self._now() + life
             return value
 
+    def put(self, key: str, value: str,
+            life: Optional[timedelta] = None) -> None:
+        self.cleanup_expiry()
+        with self._lock:
+            self._kv[key] = value
+            if life is None:
+                self._expirations.pop(key, None)
+            else:
+                self._expirations[key] = self._now() + life
+
+    def get(self, key: str) -> Optional[str]:
+        self.cleanup_expiry()
+        with self._lock:
+            return self._kv.get(key)
+
     def keys_matching(self, pattern: str) -> Iterator[str]:
         self.cleanup_expiry()
         with self._lock:

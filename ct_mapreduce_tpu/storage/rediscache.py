@@ -221,6 +221,19 @@ class RedisCache(RemoteCache):
         current = self.client.execute("GET", key)
         return current if current is not None else value
 
+    def put(self, key: str, value: str,
+            life: Optional[timedelta] = None) -> None:
+        if life is None:
+            self.client.execute("SET", key, value)
+        else:
+            self.client.execute(
+                "SET", key, value, "PX",
+                max(int(life.total_seconds() * 1000), 1),
+            )
+
+    def get(self, key: str) -> Optional[str]:
+        return self.client.execute("GET", key)
+
     def keys_matching(self, pattern: str) -> Iterator[str]:
         cursor = "0"
         while True:

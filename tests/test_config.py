@@ -242,3 +242,51 @@ def test_verify_directives(tmp_path):
     usage = CTConfig().usage()
     for d in ("verifySignatures", "verifyLogKeys"):
         assert d in usage
+
+
+def test_fleet_directives(tmp_path, monkeypatch):
+    """numWorkers / workerId / checkpointPeriod / coordinatorBackend
+    (ISSUE 9): ini + env layering, int parse, defaults, usage() — and
+    the CTMR_* env fallback behind the config values
+    (fleet.resolve_fleet)."""
+    ini = tmp_path / "ct.ini"
+    ini.write_text(
+        "numWorkers = 4\nworkerId = 2\ncheckpointPeriod = 30s\n"
+        "coordinatorBackend = redis\n")
+    cfg = CTConfig.load(argv=["--config", str(ini)], env={})
+    assert cfg.num_workers == 4
+    assert cfg.worker_id == 2
+    assert cfg.checkpoint_period == "30s"
+    assert cfg.coordinator_backend == "redis"
+    # Env beats file; unparseable env int falls back to the file value.
+    cfg2 = CTConfig.load(
+        argv=["--config", str(ini)],
+        env={"numWorkers": "8", "workerId": "5",
+             "checkpointPeriod": "1m", "coordinatorBackend": "jax"})
+    assert cfg2.num_workers == 8 and cfg2.worker_id == 5
+    assert cfg2.checkpoint_period == "1m"
+    assert cfg2.coordinator_backend == "jax"
+    cfg3 = CTConfig.load(argv=["--config", str(ini)],
+                         env={"numWorkers": "banana"})
+    assert cfg3.num_workers == 4
+    # Defaults: single worker, resolution deferred to resolve_fleet.
+    dflt = CTConfig.load(argv=[], env={})
+    assert dflt.num_workers == 0 and dflt.worker_id == 0
+    assert dflt.checkpoint_period == "" and dflt.coordinator_backend == ""
+    from ct_mapreduce_tpu.ingest.fleet import resolve_fleet
+
+    for k in ("CTMR_NUM_WORKERS", "CTMR_WORKER_ID",
+              "CTMR_CHECKPOINT_PERIOD", "CTMR_COORDINATOR"):
+        monkeypatch.delenv(k, raising=False)
+    assert resolve_fleet(dflt.num_workers, dflt.worker_id,
+                         dflt.checkpoint_period,
+                         dflt.coordinator_backend) == (1, 0, "", "")
+    monkeypatch.setenv("CTMR_NUM_WORKERS", "6")
+    monkeypatch.setenv("CTMR_CHECKPOINT_PERIOD", "45s")
+    assert resolve_fleet(dflt.num_workers, dflt.worker_id,
+                         dflt.checkpoint_period,
+                         dflt.coordinator_backend) == (6, 0, "45s", "")
+    usage = CTConfig().usage()
+    for d in ("numWorkers", "workerId", "checkpointPeriod",
+              "coordinatorBackend"):
+        assert d in usage

@@ -1,0 +1,390 @@
+"""Fleet-mode units: the deterministic partitioner, the unified
+FleetCoordinator protocol over both the mock cache and a live
+miniredis-backed RedisCache, the FleetService checkpoint cadence, and
+the per-worker aggregate merge (agg/merge.py).
+
+The end-to-end multi-PROCESS contracts — 2-worker parity with a
+serial run, SIGKILL-and-resume from checkpoint — live in
+tests/test_multiprocess.py; this file covers the pieces in-process."""
+
+import threading
+import time
+
+import pytest
+
+from ct_mapreduce_tpu.ingest import fleet
+from ct_mapreduce_tpu.storage.mockcache import MockRemoteCache
+
+URLS = [f"https://log{i}.example.com/2026" for i in range(12)]
+
+
+# -- partitioner --------------------------------------------------------
+
+
+def test_partition_disjoint_covering_deterministic():
+    for w in (1, 2, 3, 5):
+        owners = fleet.partition_map(URLS, w)
+        assert owners == fleet.partition_map(URLS, w)  # pure function
+        assert set(owners.values()) <= set(range(w))
+        parts = [fleet.partition_logs(URLS, i, w) for i in range(w)]
+        flat = [u for p in parts for u in p]
+        assert sorted(flat) == sorted(URLS)  # covering
+        assert len(flat) == len(set(flat))  # disjoint
+
+
+def test_partition_takeover_moves_only_dead_owners_logs():
+    owners = fleet.partition_map(URLS, 4)
+    dead = 0
+    alive = [w for w in range(4) if w != dead]
+    reassigned = fleet.partition_map(URLS, 4, alive=alive)
+    for url in URLS:
+        if owners[url] == dead:
+            assert reassigned[url] in alive  # re-homed to a live worker
+        else:
+            assert reassigned[url] == owners[url]  # never moved
+
+
+def test_partition_range_stripes_cover_tree():
+    for tree in (0, 1, 7, 1003):
+        for w in (1, 2, 5):
+            stripes = [fleet.partition_range(tree, i, w) for i in range(w)]
+            assert stripes[0][0] == 0
+            pos = 0
+            for off, lim in stripes:
+                assert off == pos  # contiguous, disjoint
+                pos += lim
+            assert pos == tree  # covering
+
+
+def test_worker_state_path():
+    assert fleet.worker_state_path("/s/agg.npz", 2, 4) == "/s/agg.w2.npz"
+    assert fleet.worker_state_path("/s/agg.npz", 0, 1) == "/s/agg.npz"
+    assert fleet.worker_state_path("", 2, 4) == ""
+    assert fleet.worker_state_path("/s/state", 1, 2) == "/s/state.w1"
+
+
+def test_resolve_fleet_env_layering(monkeypatch):
+    for k in ("CTMR_NUM_WORKERS", "CTMR_WORKER_ID",
+              "CTMR_CHECKPOINT_PERIOD", "CTMR_COORDINATOR"):
+        monkeypatch.delenv(k, raising=False)
+    assert fleet.resolve_fleet() == (1, 0, "", "")
+    # Explicit beats env.
+    monkeypatch.setenv("CTMR_NUM_WORKERS", "8")
+    monkeypatch.setenv("CTMR_WORKER_ID", "3")
+    monkeypatch.setenv("CTMR_CHECKPOINT_PERIOD", "30s")
+    monkeypatch.setenv("CTMR_COORDINATOR", "jax")
+    assert fleet.resolve_fleet(4, 1, "10s", "redis") == (4, 1, "10s", "redis")
+    # Env fills the gaps.
+    assert fleet.resolve_fleet() == (8, 3, "30s", "jax")
+    # Unparseable env ints are ignored.
+    monkeypatch.setenv("CTMR_NUM_WORKERS", "banana")
+    assert fleet.resolve_fleet()[0] == 1
+
+
+# -- coordinators -------------------------------------------------------
+
+
+def _elect_pair(cache, **kw):
+    c0 = fleet.CacheFleetCoordinator(cache, "t", 0, 2, **kw)
+    c1 = fleet.CacheFleetCoordinator(cache, "t", 1, 2, **kw)
+    results = {}
+
+    def go(c, i):
+        results[i] = c.start()
+        c.barrier(timeout_s=10)
+
+    ts = [threading.Thread(target=go, args=(c, i))
+          for i, c in enumerate((c0, c1))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(15)
+    assert not any(t.is_alive() for t in ts), "barrier did not release"
+    assert sorted(results.values()) == [False, True], results
+    return c0, c1, results
+
+
+def test_cache_coordinator_election_barrier_epoch_shutdown():
+    cache = MockRemoteCache()
+    c0, c1, results = _elect_pair(cache)
+    leader = c0 if results[0] else c1
+    follower = c1 if results[0] else c0
+    assert sorted(leader.alive_workers()) == [0, 1]
+    leader.publish_epoch(1)
+    leader.publish_epoch(2)  # last-writer-wins value slot
+    assert follower.current_epoch() == 2
+    assert follower.shutdown_requested() is None
+    leader.request_shutdown("drain")
+    assert follower.shutdown_requested() == "drain"
+    c0.close()
+    c1.close()
+
+
+def test_cache_coordinator_liveness_and_promotion():
+    from datetime import timedelta
+
+    cache = MockRemoteCache()
+    c0, c1, results = _elect_pair(
+        cache, liveness_timeout_s=0.2,
+        key_life_initial=timedelta(seconds=0.2),
+        key_life_renewal=timedelta(seconds=0.2))
+    leader = c0 if results[0] else c1
+    follower = c1 if results[0] else c0
+    # Leader dies: its heartbeat AND election lease expire; the
+    # follower's next heartbeat round promotes it (elastic failover,
+    # the reference's lease-expiry semantics).
+    leader._coord._stop_renewal.set()  # simulate process death
+    deadline = time.monotonic() + 5.0
+    promoted = False
+    while time.monotonic() < deadline and not promoted:
+        time.sleep(0.1)
+        follower.heartbeat()
+        assert leader.worker_id not in follower.alive_workers() or True
+        promoted = follower.maybe_promote()
+    assert promoted, "follower never inherited the expired lease"
+    assert follower.is_leader
+    # The dead leader's heartbeat is gone from the liveness view.
+    assert leader.worker_id not in follower.alive_workers()
+    c0.close()
+    c1.close()
+
+
+def test_cache_coordinator_over_live_miniredis():
+    """The same protocol over the real socket client + miniredis —
+    pins RemoteCache.put/get on the RESP path."""
+    from ct_mapreduce_tpu.storage.rediscache import RedisCache
+    from ct_mapreduce_tpu.utils.miniredis import MiniRedis
+
+    server = MiniRedis().start()
+    try:
+        cache = RedisCache(server.address)
+        c = fleet.CacheFleetCoordinator(cache, "mr", 0, 1,
+                                        liveness_timeout_s=5.0)
+        assert c.start() is True  # sole contender wins
+        c.barrier(timeout_s=5)
+        assert sorted(c.alive_workers()) == [0]
+        c.publish_epoch(7)
+        assert c.current_epoch() == 7
+        cache.put("fleet-ttl-probe", "x")
+        assert cache.get("fleet-ttl-probe") == "x"
+        c.request_shutdown("bye")
+        assert c.shutdown_requested() == "bye"
+        c.close()
+        cache.close()
+    finally:
+        server.stop()
+
+
+def test_jax_coordinator_single_process_fallback():
+    """Single-process jax: leadership is process 0, the barrier a
+    no-op, epoch/shutdown degrade to local values (no distributed
+    client to carry them)."""
+    c = fleet.JaxFleetCoordinator("t")
+    assert c.num_workers == 1 and c.worker_id == 0
+    assert c.start() is True
+    c.barrier(timeout_s=1)
+    c.publish_epoch(3)
+    assert c.current_epoch() == 3
+    c.request_shutdown("x")
+    assert c.shutdown_requested() == "x"
+    c.close()
+
+
+def test_build_coordinator_selection():
+    cache = MockRemoteCache()
+    assert isinstance(fleet.build_coordinator("", None, "t", 0, 1),
+                      fleet.SoloFleetCoordinator)
+    assert isinstance(fleet.build_coordinator("", cache, "t", 0, 2),
+                      fleet.CacheFleetCoordinator)
+    assert isinstance(fleet.build_coordinator("redis", cache, "t", 0, 1),
+                      fleet.CacheFleetCoordinator)
+    with pytest.raises(ValueError):
+        fleet.build_coordinator("zookeeper", cache, "t", 0, 2)
+    with pytest.raises(ValueError):
+        fleet.build_coordinator("redis", None, "t", 0, 2)
+
+
+# -- the service loop ---------------------------------------------------
+
+
+def test_fleet_service_checkpoint_cadence_and_stats():
+    hits = []
+    svc = fleet.FleetService(
+        fleet.SoloFleetCoordinator("s"), heartbeat_period_s=0.05,
+        checkpoint_period_s=0.1, on_checkpoint=hits.append)
+    assert svc.start(timeout_s=5) is True
+    deadline = time.monotonic() + 5.0
+    while len(hits) < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    svc.stop()
+    assert len(hits) >= 3, hits
+    assert hits == sorted(hits)  # epochs advance monotonically
+    st = svc.stats()
+    assert st["role"] == "leader"
+    assert st["workers_alive"] == [0]
+    assert st["checkpoints_run"] == len(hits)
+    assert st["checkpoint_epoch"] >= hits[-1]
+
+
+def test_fleet_service_shutdown_broadcast_and_partition():
+    cache = MockRemoteCache()
+    coord = fleet.CacheFleetCoordinator(cache, "b", 0, 2,
+                                        liveness_timeout_s=5.0)
+    seen = []
+    svc = fleet.FleetService(coord, heartbeat_period_s=0.05,
+                             on_shutdown=seen.append)
+    # Peer heartbeat so the (leader) barrier releases.
+    peer = fleet.CacheFleetCoordinator(cache, "b", 1, 2,
+                                       liveness_timeout_s=5.0)
+    peer.heartbeat()
+    svc.start(timeout_s=5)
+    mine = svc.partition(URLS)
+    assert mine == fleet.partition_logs(URLS, 0, 2)
+    assert svc.stats()["partition"] == fleet.partition_map(URLS, 2)
+    peer.request_shutdown("peer says stop")
+    deadline = time.monotonic() + 5.0
+    while not seen and time.monotonic() < deadline:
+        time.sleep(0.02)
+    svc.stop()
+    peer.close()
+    assert seen == ["peer says stop"]
+
+
+def test_engine_checkpoint_now_fans_out():
+    """checkpoint_now: live downloaders get a save request; with none
+    in flight the aggregate hook runs directly (idle workers persist
+    at the fleet cadence too)."""
+    from ct_mapreduce_tpu.ingest.sync import LogSyncEngine
+
+    hook_runs = []
+    engine = LogSyncEngine(sink=None, database=None,
+                           checkpoint_hook=lambda: hook_runs.append(1))
+    engine.checkpoint_now()
+    assert hook_runs == [1]  # idle → direct hook
+
+    class FakeWorker:
+        def __init__(self):
+            self.saves = 0
+
+        def request_save(self):
+            self.saves += 1
+
+    w = FakeWorker()
+    engine._active_workers.append(w)
+    engine.checkpoint_now()
+    assert w.saves == 1
+    assert hook_runs == [1]  # the downloader's save runs the hook
+
+
+# -- merge --------------------------------------------------------------
+
+
+def test_merge_snapshots_sums_and_unions():
+    from ct_mapreduce_tpu.agg.aggregator import AggregateSnapshot
+    from ct_mapreduce_tpu.agg.merge import merge_snapshots
+
+    a = AggregateSnapshot(
+        counts={("i1", "d1"): 3, ("i1", "d2"): 1},
+        crls={"i1": {"u1"}}, dns={"i1": {"CN=A"}},
+        total=4, verified={"i1": 2}, failed={})
+    b = AggregateSnapshot(
+        counts={("i1", "d1"): 2, ("i2", "d1"): 5},
+        crls={"i1": {"u2"}, "i2": {"u3"}}, dns={"i2": {"CN=B"}},
+        total=7, verified={"i1": 1}, failed={"i2": 4})
+    m = merge_snapshots([a, b])
+    assert m.counts == {("i1", "d1"): 5, ("i1", "d2"): 1, ("i2", "d1"): 5}
+    assert m.total == 11
+    assert m.crls == {"i1": {"u1", "u2"}, "i2": {"u3"}}
+    assert m.dns == {"i1": {"CN=A"}, "i2": {"CN=B"}}
+    assert m.verified == {"i1": 3} and m.failed == {"i2": 4}
+
+
+def test_expand_state_paths(tmp_path):
+    from ct_mapreduce_tpu.agg.merge import expand_state_paths
+
+    for w in range(3):
+        (tmp_path / f"agg.w{w}.npz").write_bytes(b"x")
+    spec = f"{tmp_path}/agg.w*.npz"
+    assert expand_state_paths(spec) == [
+        str(tmp_path / f"agg.w{w}.npz") for w in range(3)]
+    assert expand_state_paths("a.npz, b.npz") == ["a.npz", "b.npz"]
+    assert expand_state_paths("") == []
+
+
+def test_merged_checkpoints_match_single_aggregator(tmp_path):
+    """Two workers' device checkpoints fold into the same view one
+    aggregator ingesting everything produces — the reduce-side union
+    contract (disjoint serial ranges across the workers, one issuer
+    shared between them so the registry remap is exercised)."""
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.agg.merge import load_checkpoints
+    from ct_mapreduce_tpu.utils import minicert
+    from tools.fleet import snapshot_jsonable
+
+    shared = minicert.make_cert(serial=2, issuer_cn="Shared CA", is_ca=True)
+    own = [minicert.make_cert(serial=3 + w, issuer_cn=f"CA {w}", is_ca=True)
+           for w in range(2)]
+    batches = []
+    for w in range(2):
+        entries = []
+        for e in range(12):
+            leaf = minicert.make_cert(
+                serial=10_000 * (w + 1) + e,
+                issuer_cn="Shared CA" if e % 3 == 0 else f"CA {w}",
+                subject_cn=f"m{w}-{e}.example",
+                crl_dps=(f"http://crl.example/{w}.crl",))
+            entries.append((leaf, shared if e % 3 == 0 else own[w]))
+        entries.append(entries[0])  # intra-worker duplicate
+        batches.append(entries)
+
+    paths = []
+    for w, entries in enumerate(batches):
+        # batch_size 64: the walker shape test_cmd already compiled in
+        # this process — a fresh width here costs its own ~5 s compile.
+        agg = TpuAggregator(capacity=1 << 10, batch_size=64)
+        agg.ingest(entries)
+        path = str(tmp_path / f"agg.w{w}.npz")
+        agg.save_checkpoint(path)
+        paths.append(path)
+
+    ref = TpuAggregator(capacity=1 << 10, batch_size=64)
+    ref.ingest(batches[0] + batches[1])
+
+    merged = load_checkpoints(paths)
+    assert snapshot_jsonable(merged.drain()) == snapshot_jsonable(ref.drain())
+    # The merged registry unified the shared issuer across workers.
+    ids = {merged.registry.issuer_at(i).id()
+           for i in range(len(merged.registry))}
+    assert len(ids) == 3
+
+    # storage-statistics over a multi-path aggStatePath (glob) reports
+    # the fleet as ONE view, equal to the single-aggregator report —
+    # text and JSON alike.
+    import io
+
+    from ct_mapreduce_tpu.cmd import storage_statistics
+    from ct_mapreduce_tpu.config import CTConfig
+
+    ref_path = str(tmp_path / "ref.npz")
+    ref.save_checkpoint(ref_path)
+
+    def config_for(state_spec):
+        cfg = CTConfig.load(argv=[], env={})
+        cfg.backend = "tpu"
+        cfg.agg_state_path = state_spec
+        return cfg
+
+    def report_text(state_spec):
+        out = io.StringIO()
+        rc = storage_statistics.report_from_tpu_snapshot(
+            config_for(state_spec), out, 1)
+        assert rc == 0
+        return out.getvalue()
+
+    merged_text = report_text(f"{tmp_path}/agg.w*.npz")
+    assert merged_text == report_text(ref_path)
+    assert "overall totals" in merged_text
+    # JSON mode parity too (the shared collector path).
+    assert (storage_statistics.collect_tpu_report(
+                config_for(f"{tmp_path}/agg.w*.npz"))
+            == storage_statistics.collect_tpu_report(config_for(ref_path)))
