@@ -14,6 +14,7 @@ per-entry Redis round-trips; device aggregates snapshot to
 from __future__ import annotations
 
 import contextlib
+import os
 import signal
 import sys
 import threading
@@ -124,12 +125,19 @@ def build_sink(config: CTConfig, database, backend=None):
 
 
 def fleet_assignments(fleet, log_urls: list[str],
-                      takeover: bool = False) -> list[tuple]:
+                      takeover: bool = False,
+                      errors: list | None = None) -> list[tuple]:
     """This worker's share of the feed as (url, offset, limit,
     state_suffix) download assignments. Multi-log fleets partition
-    whole logs by rendezvous hash; a fleet pointed at ONE log stripes
-    its entry-index space instead (one STH fetch resolves the tree
-    size), each stripe with its own durable cursor key."""
+    whole logs by rendezvous hash, then take the per-log fetch lease
+    on each — a log whose lease another worker still holds (takeover
+    racing the owner's restart) is skipped this round and re-contended
+    next round, so no log is ever fetched by two workers at once. A
+    fleet pointed at ONE log stripes its entry-index space instead
+    (one STH fetch resolves the tree size), each stripe with its own
+    durable cursor key; an STH failure is recorded in ``errors`` and
+    yields an empty round (retried on the next poll) instead of
+    killing the worker."""
     if fleet is None:
         return [(u, None, None, "") for u in log_urls]
     if fleet.num_workers <= 1:
@@ -140,14 +148,22 @@ def fleet_assignments(fleet, log_urls: list[str],
         from ct_mapreduce_tpu.ingest.ctclient import CTLogClient
 
         url = log_urls[0]
-        tree_size = CTLogClient(url).get_sth().tree_size
+        try:
+            tree_size = CTLogClient(url).get_sth().tree_size
+        except Exception as err:
+            if errors is not None:
+                errors.append(
+                    f"{url}: STH fetch for stripe assignment failed: "
+                    f"{err}")
+            return []
         offset, limit = fleet.stripe(tree_size)
         fleet.note_stripe(url, offset, limit)
         if limit <= 0:
             return []  # more workers than entries: nothing for us
         return [(url, offset, limit, f"#w{fleet.worker_id}")]
     return [(u, None, None, "")
-            for u in fleet.partition(log_urls, takeover=takeover)]
+            for u in fleet.partition(log_urls, takeover=takeover)
+            if fleet.claim(u)]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -171,6 +187,14 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     config.agg_state_path = worker_state_path(
         config.agg_state_path, fleet_worker_id, num_workers)
+    # A durable per-worker checkpoint on disk means this process is a
+    # WARM RESTART rejoining a fleet that already crossed its start
+    # barrier: it must not re-run the barrier (peers may have finished;
+    # a stale leader lease would strand it polling a dead started key)
+    # and its first round must partition against the LIVE membership so
+    # logs a survivor took over aren't double-fetched.
+    resuming = bool(config.agg_state_path
+                    and os.path.exists(config.agg_state_path))
 
     database, _cache, _backend = get_configured_storage(config)  # noqa: F841
     dumper = prepare_telemetry("ct-fetch", config)
@@ -364,17 +388,24 @@ def main(argv: list[str] | None = None) -> int:
             # partition at once, like the reference's Redis barrier
             # (and nobody fetches before the fleet is fully present).
             run_stage["stage"] = "electing"
-            role = fleet.start(timeout_s=600.0)
+            role = fleet.start(timeout_s=600.0, rejoin=resuming)
             print(f"fleet worker {fleet.worker_id}/{num_workers} "
-                  f"({'leader' if role else 'follower'}, "
+                  f"({'leader' if role else 'follower'}"
+                  f"{', rejoined' if fleet.rejoined else ''}, "
                   f"coordinator={type(fleet.coordinator).__name__})",
                   file=sys.stderr)
         while True:
             run_stage["stage"] = "syncing"
-            # Dead-owner takeover only on later runForever rounds: the
-            # start barrier guaranteed full membership for round 0.
+            # Dead-owner takeover on later runForever rounds (the start
+            # barrier guaranteed full membership for round 0) AND on a
+            # rejoining worker's first round — its logs may be mid-
+            # takeover by a survivor, so it must partition against the
+            # live membership (the per-log lease arbitrates the races).
+            takeover = sync_round > 0 or (
+                fleet is not None and fleet.rejoined)
             for url, f_off, f_lim, f_sfx in fleet_assignments(
-                    fleet, log_urls, takeover=sync_round > 0):
+                    fleet, log_urls, takeover=takeover,
+                    errors=engine.errors):
                 engine.sync_log(url, offset=f_off, limit=f_lim,
                                 state_suffix=f_sfx)
             sync_round += 1
@@ -384,6 +415,11 @@ def main(argv: list[str] | None = None) -> int:
             if model is not None:
                 run_stage["stage"] = "saving"
                 model.save()
+            if fleet is not None:
+                # This round's entries are durably folded: drop the
+                # fetch leases so next round's rightful owners (per the
+                # then-current membership) can take them.
+                fleet.release_claims()
             run_stage["stage"] = "idle"
             # Drain this round's errors so runForever doesn't re-print
             # (or unboundedly accumulate) them across polls.

@@ -88,8 +88,9 @@ class CTConfig:
     num_workers: int = 0  # fleet size: logs partition across this many
     # ct-fetch workers by rendezvous hash (0 = CTMR_NUM_WORKERS env,
     # then 1 = single-worker)
-    worker_id: int = 0  # this worker's id in [0, numWorkers)
-    # (0 = CTMR_WORKER_ID env, then 0)
+    worker_id: int = -1  # this worker's id in [0, numWorkers)
+    # (-1 = unset → CTMR_WORKER_ID env, then 0; 0 is a REAL id, so an
+    # explicit workerId = 0 beats a stray env value)
     checkpoint_period: str = ""  # leader-published checkpoint cadence
     # (durable aggregate snapshot + cursors on every epoch tick;
     # "" = CTMR_CHECKPOINT_PERIOD env, then no fleet cadence — the
@@ -329,7 +330,8 @@ class CTConfig:
             "stripes the entry-index space (CTMR_NUM_WORKERS "
             "equivalent)",
             "workerId = this worker's id in [0, numWorkers) "
-            "(CTMR_WORKER_ID equivalent)",
+            "(CTMR_WORKER_ID equivalent; an explicit 0 pins worker 0 "
+            "even when the env var is set)",
             "checkpointPeriod = leader-published checkpoint cadence: "
             "every tick, each worker snapshots aggregates + cursors "
             "atomically for warm restart (CTMR_CHECKPOINT_PERIOD "

@@ -54,7 +54,7 @@ import hashlib
 import os
 import threading
 import time
-from datetime import timedelta
+from datetime import datetime, timedelta, timezone
 from typing import Callable, Iterable, Optional, Protocol, Sequence
 
 from ct_mapreduce_tpu.telemetry import metrics
@@ -63,6 +63,12 @@ from ct_mapreduce_tpu.telemetry import metrics
 HEARTBEAT_KEY_PREFIX = "fleet-hb-"
 EPOCH_KEY_PREFIX = "fleet-epoch-"
 STOP_KEY_PREFIX = "fleet-stop-"
+CLAIM_KEY_PREFIX = "fleet-claim-"
+
+# A shutdown broadcast only needs to outlive every worker's observation
+# poll (sub-second); the TTL bounds how long a stale broadcast can
+# survive in a PERSISTENT Redis after the fleet is gone.
+STOP_KEY_LIFE = timedelta(minutes=5)
 
 
 # -- deterministic partitioner ------------------------------------------
@@ -135,15 +141,17 @@ def worker_state_path(path: str, worker_id: int, num_workers: int) -> str:
     return f"{root}.w{worker_id}{ext}"
 
 
-def resolve_fleet(num_workers: int = 0, worker_id: int = 0,
+def resolve_fleet(num_workers: int = 0, worker_id: int = -1,
                   checkpoint_period: str = "",
                   backend: str = "") -> tuple[int, int, str, str]:
     """Resolve the fleet knobs: explicit value (config directive) >
     ``CTMR_NUM_WORKERS`` / ``CTMR_WORKER_ID`` /
     ``CTMR_CHECKPOINT_PERIOD`` / ``CTMR_COORDINATOR`` env > defaults
     (1 worker, id 0, no checkpoint cadence, auto backend).
-    Unparseable env values are ignored, matching the config layer's
-    tolerance."""
+    ``worker_id`` uses -1 as its unset sentinel: 0 is a real id (the
+    one id every fleet must have exactly once), so a config that pins
+    ``workerId = 0`` must beat a stray env value. Unparseable env
+    values are ignored, matching the config layer's tolerance."""
 
     def env_int(name: str) -> Optional[int]:
         raw = os.environ.get(name, "")
@@ -155,8 +163,8 @@ def resolve_fleet(num_workers: int = 0, worker_id: int = 0,
     n = int(num_workers or 0)
     if n <= 0:
         n = env_int("CTMR_NUM_WORKERS") or 1
-    wid = int(worker_id or 0)
-    if wid <= 0:
+    wid = int(worker_id)
+    if wid < 0:
         wid = env_int("CTMR_WORKER_ID") or 0
     period = checkpoint_period or os.environ.get(
         "CTMR_CHECKPOINT_PERIOD", "")
@@ -176,13 +184,22 @@ class FleetCoordinator(Protocol):
     lease; ``alive_workers()`` maps live worker ids to heartbeat ages;
     ``publish_epoch``/``current_epoch`` carry the leader's checkpoint
     cadence ticks; ``request_shutdown``/``shutdown_requested`` the
-    clean-shutdown broadcast."""
+    clean-shutdown broadcast. ``fleet_started`` (after ``start()``)
+    reports whether the current leadership already published its start
+    barrier — i.e. this worker is REJOINING a running fleet;
+    ``publish_start`` lets a rejoining leader re-publish the barrier
+    without waiting for full membership. ``claim_log``/``release_log``
+    are the per-log fetch lease: at most one worker holds a log at a
+    time, so partition-map disagreement windows (dead-owner takeover
+    racing the owner's warm restart) cannot double-fetch."""
 
     worker_id: int
     num_workers: int
 
     def start(self) -> bool: ...
     def barrier(self, timeout_s: Optional[float] = None) -> None: ...
+    def fleet_started(self) -> bool: ...
+    def publish_start(self) -> None: ...
     def heartbeat(self) -> None: ...
     def alive_workers(self) -> dict[int, float]: ...
     def maybe_promote(self) -> bool: ...
@@ -190,6 +207,8 @@ class FleetCoordinator(Protocol):
     def current_epoch(self) -> int: ...
     def request_shutdown(self, reason: str) -> None: ...
     def shutdown_requested(self) -> Optional[str]: ...
+    def claim_log(self, log_url: str) -> bool: ...
+    def release_log(self, log_url: str) -> None: ...
     def close(self) -> None: ...
 
 
@@ -214,6 +233,12 @@ class SoloFleetCoordinator:
     def barrier(self, timeout_s: Optional[float] = None) -> None:
         pass
 
+    def fleet_started(self) -> bool:
+        return False
+
+    def publish_start(self) -> None:
+        pass
+
     def heartbeat(self) -> None:
         self._beat = time.monotonic()
 
@@ -234,6 +259,12 @@ class SoloFleetCoordinator:
 
     def shutdown_requested(self) -> Optional[str]:
         return self._stop
+
+    def claim_log(self, log_url: str) -> bool:
+        return True  # sole worker: every log is uncontended
+
+    def release_log(self, log_url: str) -> None:
+        pass
 
     def close(self) -> None:
         pass
@@ -287,8 +318,25 @@ class CacheFleetCoordinator:
     def _stop_key(self) -> str:
         return STOP_KEY_PREFIX + self.name
 
+    def _claim_key(self, log_url: str) -> str:
+        digest = hashlib.sha256(log_url.encode()).hexdigest()[:16]
+        return f"{CLAIM_KEY_PREFIX}{self.name}-{digest}"
+
+    def _clear_key(self, key: str) -> None:
+        """RemoteCache has no DEL; EXPIREAT in the past is the
+        portable equivalent (Redis deletes the key immediately; the
+        mock and miniredis purge it on the next touch)."""
+        self.cache.expire_at(
+            key, datetime(1970, 1, 2, tzinfo=timezone.utc))
+
     # -- lifecycle -------------------------------------------------------
     def start(self) -> bool:
+        # Absorb a stale shutdown broadcast before anything can observe
+        # it: against a PERSISTENT Redis, the previous run's signal-
+        # driven stop key would otherwise self-terminate this run the
+        # moment the service loop starts (the stop-key analog of
+        # FleetService initializing _epoch_seen from current_epoch()).
+        self._clear_key(self._stop_key)
         self.heartbeat()
         self.is_leader = self._coord.await_leader()
         return self.is_leader
@@ -308,6 +356,34 @@ class CacheFleetCoordinator:
                     f"start barrier: {sorted(self.alive_workers())} of "
                     f"{self.num_workers} workers present")
             time.sleep(self.poll_period_s)
+        self._coord.send_start()
+
+    def fleet_started(self) -> bool:
+        """After ``start()``: has the CURRENT leadership already
+        published its start barrier? True means this worker is
+        rejoining a running fleet (its own barrier crossing happened in
+        a previous incarnation) and must not block on — or re-form —
+        the barrier. Scoped to the incumbent's election identifier, so
+        a fresh fleet on a persistent Redis never false-positives on
+        another run's leftovers (started keys are TTL'd and named by
+        identifier)."""
+        from ct_mapreduce_tpu.coordinator.coordinator import (
+            STARTED_KEY_PREFIX,
+        )
+
+        ident = self._coord.identifier
+        if not ident or self._coord.is_leader:
+            # A leader's own started key can't predate its election:
+            # identifiers are unique per await_leader() call.
+            return False
+        return self.cache.exists(STARTED_KEY_PREFIX + ident)
+
+    def publish_start(self) -> None:
+        """Leader-only: publish the start barrier WITHOUT waiting for
+        full membership — the rejoin path (a restarted worker that
+        inherited an expired lease must release any followers polling
+        the barrier, and full membership may never re-form if peers
+        already finished)."""
         self._coord.send_start()
 
     def heartbeat(self) -> None:
@@ -351,10 +427,36 @@ class CacheFleetCoordinator:
             return 0
 
     def request_shutdown(self, reason: str) -> None:
-        self.cache.put(self._stop_key, reason or "stop")
+        # TTL'd so a persistent Redis can't replay this broadcast into
+        # a later run forever (start() also clears it defensively).
+        self.cache.put(self._stop_key, reason or "stop",
+                       life=STOP_KEY_LIFE)
 
     def shutdown_requested(self) -> Optional[str]:
-        return self.cache.get(self._stop_key)
+        return self.cache.get(self._stop_key) or None
+
+    # -- per-log fetch lease ---------------------------------------------
+    def claim_log(self, log_url: str) -> bool:
+        """Acquire (or re-affirm) the exclusive fetch lease on one log.
+        SETNX with the worker id as the value: the holder re-affirms
+        (refreshing the TTL — the renewal rides the FleetService
+        heartbeat loop), everyone else is refused until the lease
+        expires or is released. This is what makes dead-owner takeover
+        safe against the owner's warm restart: both may COMPUTE
+        ownership of the same log in the disagreement window, but only
+        one can hold the lease, so entries are never fetched twice
+        concurrently (agg/merge.py's disjointness assumption)."""
+        me = str(self.worker_id)
+        life = timedelta(seconds=self.liveness_timeout_s)
+        holder = self.cache.try_set(self._claim_key(log_url), me, life)
+        if holder != me:
+            return False
+        self.cache.put(self._claim_key(log_url), me, life=life)
+        return True
+
+    def release_log(self, log_url: str) -> None:
+        if self.cache.get(self._claim_key(log_url)) == str(self.worker_id):
+            self._clear_key(self._claim_key(log_url))
 
     def close(self) -> None:
         self._coord.close()
@@ -400,6 +502,16 @@ class JaxFleetCoordinator:
         else:
             self._coord.await_start(timeout_s=timeout_s)
 
+    def fleet_started(self) -> bool:
+        # jax.distributed jobs form collectively: a dead process tears
+        # the job down, so a single worker can never rejoin a running
+        # fleet — every start is a cold start.
+        return False
+
+    def publish_start(self) -> None:
+        if self.is_leader:
+            self._coord.send_start()
+
     def heartbeat(self) -> None:
         self._beat = time.monotonic()
 
@@ -442,6 +554,14 @@ class JaxFleetCoordinator:
 
         raw = distributed.kv_get(self._kv("stop"))
         return raw if raw is not None else self._local_stop
+
+    def claim_log(self, log_url: str) -> bool:
+        # Membership is fixed by the runtime (alive_workers is always
+        # the full set), so ownership never moves and leases are moot.
+        return True
+
+    def release_log(self, log_url: str) -> None:
+        pass
 
     def close(self) -> None:
         self._coord.close()
@@ -496,6 +616,7 @@ class FleetService:
         self.on_checkpoint = on_checkpoint
         self.on_shutdown = on_shutdown
         self.is_leader = False
+        self.rejoined = False
         self.checkpoints_run = 0
         self._epoch_seen = 0
         self._stop = threading.Event()
@@ -504,20 +625,29 @@ class FleetService:
         self._lock = threading.Lock()
         self._partition: dict[str, int] = {}
         self._stripe: Optional[dict] = None
+        self._claims: set[str] = set()
         self._errors: list[str] = []
 
     # -- lifecycle -------------------------------------------------------
     def start(self, timeout_s: Optional[float] = None,
-              await_barrier: bool = True) -> bool:
+              await_barrier: bool = True, rejoin: bool = False) -> bool:
         """Elect, heartbeat, cross the start barrier, and start the
         background loop. A RESTARTED worker rejoining a running fleet
-        passes ``await_barrier=False``: the original barrier has long
-        been published and peers may already have finished — a rejoin
-        must never block the resume on it."""
+        must never block the resume on the original barrier (long
+        published, and peers may already have finished): a rejoin is
+        detected from the coordinator (the incumbent leadership's
+        published start key) or asserted by the caller via ``rejoin``
+        (e.g. a durable per-worker checkpoint on disk). A rejoining
+        worker that inherited an expired leader lease re-publishes the
+        start key instead of waiting for membership that may never
+        re-form. ``await_barrier=False`` skips the barrier outright."""
         self.is_leader = self.coordinator.start()
         self.coordinator.heartbeat()
-        if await_barrier:
+        self.rejoined = bool(rejoin) or self.coordinator.fleet_started()
+        if await_barrier and not self.rejoined:
             self.coordinator.barrier(timeout_s=timeout_s)
+        elif self.rejoined and self.is_leader:
+            self.coordinator.publish_start()
         self._epoch_seen = self.coordinator.current_epoch()
         self._thread = threading.Thread(
             target=self._loop, name="fleet", daemon=True)
@@ -529,6 +659,7 @@ class FleetService:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        self.release_claims()
         self.coordinator.close()
 
     # -- background loop -------------------------------------------------
@@ -543,6 +674,7 @@ class FleetService:
                 now = time.monotonic()
                 if now >= next_beat:
                     self.coordinator.heartbeat()
+                    self._renew_claims()
                     next_beat = now + self.heartbeat_period_s
                     self._observe_liveness()
                 if (next_epoch_tick is not None and self.is_leader
@@ -611,6 +743,39 @@ class FleetService:
         """This worker's entry-index stripe of a single log."""
         return partition_range(tree_size, self.worker_id, self.num_workers)
 
+    # -- per-log fetch leases --------------------------------------------
+    def claim(self, log_url: str) -> bool:
+        """Take the exclusive fetch lease on one partitioned log for
+        this round; the background loop renews held leases every
+        heartbeat. A refusal means another worker (takeover survivor
+        or the restarted owner, whichever won) is mid-fetch — skip the
+        log this round and re-contend on the next one."""
+        ok = self.coordinator.claim_log(log_url)
+        if ok:
+            with self._lock:
+                self._claims.add(log_url)
+        metrics.set_gauge("fleet", "claims_held",
+                          value=float(len(self._claims)))
+        return ok
+
+    def release_claims(self) -> None:
+        """Drop every held lease (end of a sync round / shutdown) so
+        the next round's rightful owners can take them."""
+        with self._lock:
+            claims, self._claims = sorted(self._claims), set()
+        for url in claims:
+            try:
+                self.coordinator.release_log(url)
+            except Exception:
+                pass  # an unreleased lease just expires with its TTL
+        metrics.set_gauge("fleet", "claims_held", value=0.0)
+
+    def _renew_claims(self) -> None:
+        with self._lock:
+            claims = sorted(self._claims)
+        for url in claims:
+            self.coordinator.claim_log(url)
+
     def note_stripe(self, log_url: str, offset: int, limit: int) -> None:
         """Record a single-log entry-range assignment for stats() (the
         whole-log partition map doesn't apply in stripe mode)."""
@@ -634,11 +799,14 @@ class FleetService:
         with self._lock:
             partition = dict(self._partition)
             stripe = dict(self._stripe) if self._stripe else None
+            claims = sorted(self._claims)
             errors = list(self._errors)
         body = {
             "role": "leader" if self.is_leader else "follower",
             "worker_id": self.worker_id,
             "num_workers": self.num_workers,
+            "rejoined": self.rejoined,
+            "claims": claims,
             "workers_alive": sorted(alive),
             "heartbeat_age_s": {str(w): round(a, 3)
                                 for w, a in sorted(alive.items())},

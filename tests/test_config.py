@@ -269,9 +269,10 @@ def test_fleet_directives(tmp_path, monkeypatch):
     cfg3 = CTConfig.load(argv=["--config", str(ini)],
                          env={"numWorkers": "banana"})
     assert cfg3.num_workers == 4
-    # Defaults: single worker, resolution deferred to resolve_fleet.
+    # Defaults: single worker, resolution deferred to resolve_fleet
+    # (workerId's unset sentinel is -1 — 0 is a real, pinnable id).
     dflt = CTConfig.load(argv=[], env={})
-    assert dflt.num_workers == 0 and dflt.worker_id == 0
+    assert dflt.num_workers == 0 and dflt.worker_id == -1
     assert dflt.checkpoint_period == "" and dflt.coordinator_backend == ""
     from ct_mapreduce_tpu.ingest.fleet import resolve_fleet
 
@@ -286,6 +287,15 @@ def test_fleet_directives(tmp_path, monkeypatch):
     assert resolve_fleet(dflt.num_workers, dflt.worker_id,
                          dflt.checkpoint_period,
                          dflt.coordinator_backend) == (6, 0, "45s", "")
+    # An ini that explicitly pins workerId = 0 beats a stray env id.
+    monkeypatch.setenv("CTMR_WORKER_ID", "4")
+    pinned = CTConfig.load(
+        argv=["--config", str(ini)], env={"workerId": "0"})
+    assert pinned.worker_id == 0
+    assert resolve_fleet(pinned.num_workers, pinned.worker_id,
+                         "", "")[1] == 0
+    # ...while an UNSET workerId still takes the env value.
+    assert resolve_fleet(dflt.num_workers, dflt.worker_id, "", "")[1] == 4
     usage = CTConfig().usage()
     for d in ("numWorkers", "workerId", "checkpointPeriod",
               "coordinatorBackend"):

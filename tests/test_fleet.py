@@ -74,6 +74,9 @@ def test_resolve_fleet_env_layering(monkeypatch):
     monkeypatch.setenv("CTMR_CHECKPOINT_PERIOD", "30s")
     monkeypatch.setenv("CTMR_COORDINATOR", "jax")
     assert fleet.resolve_fleet(4, 1, "10s", "redis") == (4, 1, "10s", "redis")
+    # Explicit workerId = 0 is a real id (every fleet needs exactly one
+    # worker 0), NOT the unset sentinel — it must beat the env too.
+    assert fleet.resolve_fleet(4, 0, "10s", "redis")[1] == 0
     # Env fills the gaps.
     assert fleet.resolve_fleet() == (8, 3, "30s", "jax")
     # Unparseable env ints are ignored.
@@ -116,6 +119,169 @@ def test_cache_coordinator_election_barrier_epoch_shutdown():
     assert follower.shutdown_requested() is None
     leader.request_shutdown("drain")
     assert follower.shutdown_requested() == "drain"
+    c0.close()
+    c1.close()
+
+
+def test_stale_shutdown_broadcast_not_replayed():
+    """A stop broadcast left in a PERSISTENT cache by a previous
+    (signal-stopped) run must not self-terminate the next run: the
+    key is TTL'd at publish and cleared when a coordinator starts."""
+    from datetime import timedelta
+
+    cache = MockRemoteCache()
+    old = fleet.CacheFleetCoordinator(cache, "t", 0, 1)
+    assert old.start() is True
+    old.request_shutdown("leader signal 15")
+    assert old.shutdown_requested() == "leader signal 15"
+    old.close()
+    # The broadcast key carries a TTL (a persistent Redis must not
+    # keep it forever even if no successor run ever starts).
+    assert cache._expirations.get(fleet.STOP_KEY_PREFIX + "t") is not None
+    # Simulate the restart: the stale lease is still live (fresh cache
+    # state, no time passed), but start() absorbs the stale broadcast.
+    fresh = fleet.CacheFleetCoordinator(cache, "t", 0, 1)
+    fresh.start()
+    assert fresh.shutdown_requested() is None
+    svc = fleet.FleetService(fresh, heartbeat_period_s=0.05,
+                             on_shutdown=lambda r: pytest.fail(
+                                 f"stale broadcast replayed: {r}"))
+    svc.start(timeout_s=5, await_barrier=False)
+    time.sleep(0.3)  # several observation rounds
+    svc.stop()
+    # A FRESH broadcast still works after the clear.
+    fresh.request_shutdown("real stop")
+    assert fresh.shutdown_requested() == "real stop"
+
+
+def test_claim_log_exclusive_lease():
+    """The per-log fetch lease: one holder at a time, re-affirmable by
+    the holder (and by its same-id restart), transferable only after
+    release or TTL expiry — the guard against takeover/warm-restart
+    double-fetch."""
+    cache = MockRemoteCache()
+    c0 = fleet.CacheFleetCoordinator(cache, "t", 0, 2)
+    c1 = fleet.CacheFleetCoordinator(cache, "t", 1, 2)
+    url = URLS[0]
+    assert c0.claim_log(url) is True
+    assert c1.claim_log(url) is False  # held
+    assert c0.claim_log(url) is True  # holder re-affirms (TTL refresh)
+    c1.release_log(url)  # non-holder release is a no-op
+    assert c1.claim_log(url) is False
+    c0.release_log(url)
+    assert c1.claim_log(url) is True  # transferred after release
+    # A restart with the holder's id re-affirms the old incarnation's
+    # lease instead of deadlocking against itself.
+    c1b = fleet.CacheFleetCoordinator(cache, "t", 1, 2)
+    assert c1b.claim_log(url) is True
+    for c in (c0, c1, c1b):
+        c.close()
+
+
+def test_fleet_service_claims_filter_and_release():
+    cache = MockRemoteCache()
+    svc = fleet.FleetService(
+        fleet.CacheFleetCoordinator(cache, "cl", 0, 2))
+    peer = fleet.CacheFleetCoordinator(cache, "cl", 1, 2)
+    taken = URLS[0]
+    assert peer.claim_log(taken)
+    assert svc.claim(taken) is False
+    free = URLS[1]
+    assert svc.claim(free) is True
+    assert svc.stats()["claims"] == [free]
+    svc.release_claims()
+    assert svc.stats()["claims"] == []
+    assert peer.claim_log(free) is True  # released → claimable
+    peer.close()
+    svc.coordinator.close()
+
+
+def test_fleet_assignments_sth_failure_contained(monkeypatch):
+    """Stripe mode resolves the tree size with one STH fetch inside
+    the main run loop; a transient failure there must land in the
+    engine's per-round error list (empty round, retried next poll),
+    not propagate and kill the worker process."""
+    from ct_mapreduce_tpu.cmd import ct_fetch
+    from ct_mapreduce_tpu.ingest import ctclient
+
+    class BoomClient:
+        def __init__(self, url):
+            pass
+
+        def get_sth(self):
+            raise OSError("connection refused")
+
+    monkeypatch.setattr(ctclient, "CTLogClient", BoomClient)
+    svc = fleet.FleetService(
+        fleet.CacheFleetCoordinator(MockRemoteCache(), "sth", 0, 2))
+    errors = []
+    out = ct_fetch.fleet_assignments(
+        svc, ["https://log.example/a"], errors=errors)
+    assert out == []
+    assert len(errors) == 1 and "STH fetch" in errors[0]
+    svc.coordinator.close()
+
+
+def test_fleet_assignments_skips_leased_logs():
+    """A log whose fetch lease another worker still holds (takeover
+    survivor vs. the owner's restart) is excluded from this round's
+    assignments and picked up again once the lease is released."""
+    from ct_mapreduce_tpu.cmd import ct_fetch
+
+    cache = MockRemoteCache()
+    svc = fleet.FleetService(
+        fleet.CacheFleetCoordinator(cache, "as", 0, 2))
+    peer = fleet.CacheFleetCoordinator(cache, "as", 1, 2)
+    mine = fleet.partition_logs(URLS, 0, 2)
+    assert len(mine) >= 2
+    held = mine[0]
+    assert peer.claim_log(held)  # survivor mid-fetch of our log
+    urls = [u for (u, _, _, _) in ct_fetch.fleet_assignments(svc, URLS)]
+    assert held not in urls
+    assert urls == [u for u in mine if u != held]
+    svc.release_claims()
+    peer.release_log(held)
+    urls = [u for (u, _, _, _) in ct_fetch.fleet_assignments(svc, URLS)]
+    assert urls == mine  # re-contended and won next round
+    svc.release_claims()
+    peer.close()
+    svc.coordinator.close()
+
+
+def test_rejoin_skips_barrier_and_republishes_start():
+    """A restarted worker rejoining a running fleet must not block on
+    the start barrier: a follower behind the incumbent's published
+    start key detects the rejoin itself; a worker that inherited an
+    expired lease (or one asserting local checkpoint evidence via
+    ``rejoin=True``) re-publishes the start key instead of waiting for
+    membership that may never re-form."""
+    cache = MockRemoteCache()
+    c0, c1, results = _elect_pair(cache)  # running fleet, barrier done
+    # Case 1: respawn as a FOLLOWER behind the still-live lease — the
+    # incumbent's started key marks the fleet as already running.
+    re0 = fleet.CacheFleetCoordinator(cache, "t", 0, 2)
+    svc = fleet.FleetService(re0)
+    t0 = time.monotonic()
+    svc.start(timeout_s=30)  # must not wait anywhere near timeout
+    assert time.monotonic() - t0 < 5.0
+    assert svc.rejoined is True
+    assert re0.fleet_started() is True
+    assert svc.stats()["rejoined"] is True
+    svc.stop()
+    # Case 2: caller-asserted rejoin on a LEADER (fresh cache simulates
+    # the expired-lease takeover; peers finished, membership will never
+    # re-form): start() returns immediately and the start key is
+    # re-published so any polling follower is released.
+    from ct_mapreduce_tpu.coordinator.coordinator import STARTED_KEY_PREFIX
+
+    cache2 = MockRemoteCache()
+    lead = fleet.CacheFleetCoordinator(cache2, "t", 0, 2)
+    svc2 = fleet.FleetService(lead)
+    t0 = time.monotonic()
+    assert svc2.start(timeout_s=30, rejoin=True) is True
+    assert time.monotonic() - t0 < 5.0, "rejoining leader blocked"
+    assert cache2.exists(STARTED_KEY_PREFIX + lead._coord.identifier)
+    svc2.stop()
     c0.close()
     c1.close()
 
