@@ -1891,6 +1891,151 @@ def run_verify_smoke() -> dict:
     }
 
 
+def run_filter_smoke() -> dict:
+    """CT_BENCH_SMOKE filter leg (round 15): filter-cascade emission
+    from a fuzz-populated aggregation state, CPU-only.
+
+    A randomized wire corpus (multiple issuers/expiry buckets,
+    duplicate serials, the real AggregatorSink decode path) ingests at
+    the overlap leg's exact compile shapes (chunk 1024, 2^14-slot
+    table — one process pays the jit once across the smoke), then the
+    checkpoint-time emission path compiles the filter artifact and the
+    leg enforces:
+
+      (1) ZERO false negatives over the FULL included set — every
+          serial the aggregation state knows answers known through the
+          cascade (and the capture's per-group sizes equal the drained
+          report's counts exactly; filter-over-a-GROWN-table is pinned
+          by tests/test_filter.py, which rehashes mid-corpus);
+      (2) measured FP rate ≤ 2× the 0.01 target over a disjoint probe
+          corpus (serial length outside the ingested space, so no
+          probe can collide with an included identity);
+      (3) determinism: a rebuild from the same state is byte-identical
+          — and bits/entry + build rate are recorded for the BENCHLOG
+          curve (tools/filtercost.py sweeps the full rate curve).
+    """
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as _np
+
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.filter import read_artifact
+    from ct_mapreduce_tpu.ingest.sync import AggregatorSink, RawBatch
+    from ct_mapreduce_tpu.utils import syncerts
+
+    fp_rate = 0.01
+    chunk = 1024
+    n_chunks = 2
+    tpls = [syncerts.make_template(issuer_cn=f"Filter Smoke CA {k}")
+            for k in range(3)]
+    raw_batches = []
+    for i in range(n_chunks):
+        lis, eds = syncerts.make_wire_batch(tpls, i * chunk, chunk)
+        raw_batches.append(RawBatch(lis, eds, i * chunk, "filter-smoke"))
+    # Duplicate replay: the capture must not double-count dedup hits.
+    lis, eds = syncerts.make_wire_batch(tpls, 0, chunk)
+    raw_batches.append(RawBatch(lis, eds, n_chunks * chunk,
+                                "filter-smoke"))
+
+    agg = TpuAggregator(capacity=1 << 14, batch_size=chunk)
+    sink = AggregatorSink(agg, flush_size=chunk, device_queue_depth=1)
+    agg.enable_filter_capture()
+    t0 = time.monotonic()
+    for rb in raw_batches:
+        sink.store_raw_batch(rb)
+    sink.flush()
+    ingest_s = time.monotonic() - t0
+    snap = agg.drain()
+
+    # (1a) capture == drained report, group for group.
+    from ct_mapreduce_tpu.core.types import ExpDate
+
+    cap_counts = {}
+    for (idx, eh), serials in agg.filter_capture.items():
+        key = (agg.registry.issuer_at(idx).id(),
+               ExpDate.from_unix_hour(eh).id())
+        cap_counts[key] = cap_counts.get(key, 0) + len(serials)
+    if cap_counts != dict(snap.counts):
+        raise BenchError(
+            f"filter smoke: capture disagrees with the drained report "
+            f"(capture {cap_counts} vs report {dict(snap.counts)})")
+
+    state_dir = tempfile.mkdtemp(prefix="ct-filter-smoke-")
+    state_path = os.path.join(state_dir, "agg.npz")
+    filter_path = state_path + ".filter"
+    agg.configure_filter_emission(filter_path, fp_rate)
+    t0 = time.monotonic()
+    agg.save_checkpoint(state_path)
+    emit_s = time.monotonic() - t0
+    art = read_artifact(filter_path)
+
+    # (1b) zero false negatives over the full included set.
+    total = fn = 0
+    for (idx, eh), serials in sorted(agg.filter_capture.items()):
+        g = art.group_for(agg.registry.issuer_at(idx).id(), eh)
+        if g is None:
+            raise BenchError(f"filter smoke: group missing for "
+                             f"({idx}, {eh})")
+        serials = sorted(serials)
+        hits = art.query_group(g, serials)
+        fn += int((~hits).sum())
+        total += len(serials)
+    if fn:
+        raise BenchError(f"filter smoke: {fn}/{total} false negatives")
+
+    # (2) measured FP over a disjoint probe corpus: 21-byte serials
+    # cannot collide with any ingested identity (serial length is part
+    # of the fingerprint message).
+    rng = _np.random.default_rng(20260805)
+    probes = [rng.integers(0, 256, 21, dtype=_np.uint8).tobytes()
+              for _ in range(4000)]
+    fp = probed = 0
+    for (iss, exp_id), g in sorted(art.groups.items()):
+        hits = art.query_group(g, probes)
+        fp += int(_np.asarray(hits).sum())
+        probed += len(probes)
+    fp_measured = fp / max(1, probed)
+    if fp_measured > 2 * fp_rate:
+        raise BenchError(
+            f"filter smoke: measured FP {fp_measured:.4f} > "
+            f"2x target {fp_rate}")
+
+    # (3) determinism: rebuild from the same state, byte for byte.
+    from ct_mapreduce_tpu.filter import build_from_aggregator
+
+    blob = art.to_bytes()
+    if build_from_aggregator(agg, fp_rate=fp_rate).to_bytes() != blob:
+        raise BenchError("filter smoke: rebuild is not byte-identical")
+
+    sink.close()
+    build_rate = total / max(emit_s, 1e-9)
+    log(f"filter smoke: {total} serials / {len(art.groups)} groups -> "
+        f"{len(blob)} B ({art.bits_per_entry():.2f} bits/entry, "
+        f"{art.max_layers()} layers) in {emit_s:.2f}s; "
+        f"measured FP {fp_measured:.4f} (target {fp_rate}), 0 FN")
+    return {
+        "metric": "ct_filter_smoke",
+        "value": build_rate,
+        "unit": "serials/s",
+        "smoke_filter_serials": total,
+        "smoke_filter_groups": len(art.groups),
+        "smoke_filter_bytes": len(blob),
+        "smoke_filter_bits_per_entry": art.bits_per_entry(),
+        "smoke_filter_max_layers": art.max_layers(),
+        "smoke_filter_false_negatives": fn,
+        "smoke_filter_fp_target": fp_rate,
+        "smoke_filter_fp_measured": fp_measured,
+        "smoke_filter_probes": probed,
+        "smoke_filter_table_capacity": agg.capacity,
+        "smoke_filter_ingest_s": ingest_s,
+        "smoke_filter_emit_s": emit_s,
+    }
+
+
 def run_fleet_smoke() -> dict:
     """CT_BENCH_SMOKE fleet leg (round 14): W ∈ {1, 2} local ct-fetch
     worker PROCESSES over a shared fakelog fixture, coordinated

@@ -542,6 +542,18 @@ class TpuAggregator:
         # Set False by a sink that never materializes PEMs: skips the
         # per-entry serial-bytes construction in `_consume_out`.
         self.want_serials = True
+        # Filter capture (round 15): when enabled, every first-seen
+        # serial's BYTES are retained per (issuer_idx, exp_hour) so the
+        # reduce state can compile crlite-style filter artifacts — the
+        # device table keeps only hashed fingerprints, which cannot
+        # seed a cross-run-deterministic filter. None = off (default):
+        # zero overhead and byte-identical checkpoints.
+        self.filter_capture: Optional[dict[tuple[int, int],
+                                           set[bytes]]] = None
+        # Checkpoint-time filter emission (configure_filter_emission):
+        # empty path = no artifact written.
+        self.emit_filter_path = ""
+        self.filter_fp_rate = 0.01
         self.set_cn_prefixes(cn_prefixes)
         self.metrics: dict[str, int] = {
             "inserted": 0, "known": 0, "filtered_ca": 0, "filtered_expired": 0,
@@ -785,6 +797,47 @@ class TpuAggregator:
                     int(self.verify_failed[i]),
                 )
         return out
+
+    # -- filter capture (round 15) ---------------------------------------
+    def enable_filter_capture(self) -> None:
+        """Start retaining first-seen serial bytes per (issuer_idx,
+        exp_hour) for filter compilation. Seeds from the host-lane
+        sets (their bytes survive checkpoints); device-lane serials
+        ingested BEFORE enabling are hashes only and cannot be
+        recovered — enabling mid-life on a warm table yields a filter
+        covering the capture window, and says so once on stderr.
+        Forces ``want_serials`` (capture needs the bytes the count-only
+        fast path skips)."""
+        if self.filter_capture is None:
+            self.filter_capture = {
+                key: set(serials)
+                for key, serials in self.host_serials.items()
+            }
+            if self._device_written and self._table_fill_exact() > 0:
+                print(
+                    "filter capture enabled on a warm table: device-lane "
+                    "serials ingested before this point are fingerprints "
+                    "only and will be missing from emitted filters",
+                    file=sys.stderr,
+                )
+        self.want_serials = True
+
+    def configure_filter_emission(self, path: str,
+                                  fp_rate: float = 0.01) -> None:
+        """Emit a filter artifact (``path``) on every checkpoint save,
+        compiled from the capture at the target FP rate."""
+        self.emit_filter_path = path
+        if fp_rate > 0:
+            self.filter_fp_rate = float(fp_rate)
+        self.enable_filter_capture()
+
+    def _capture_serial(self, issuer_idx: int, exp_hour: int,
+                        serial: bytes) -> None:
+        """Record one first-seen serial (fold paths call this under
+        the fold lock; set semantics absorb cross-domain repeats)."""
+        if self.filter_capture is not None:
+            self.filter_capture.setdefault(
+                (issuer_idx, exp_hour), set()).add(serial)
 
     # -- ingest ----------------------------------------------------------
     def ingest(self, entries: list[tuple[bytes, bytes]]) -> IngestResult:
@@ -1246,6 +1299,7 @@ class TpuAggregator:
                         self.issuer_totals[int(plan.issuer_idx[p_])] -= 1
                     else:
                         res.was_unknown[p_] = True
+                        self._capture_serial(key[0], key[1], sb)
         else:
             res.was_unknown[wu] = True
         ksel = np.nonzero(res.was_unknown[:n])[0]
@@ -1373,6 +1427,7 @@ class TpuAggregator:
                         self.issuer_totals[int(batch.issuer_idx[l_])] -= 1
                     else:
                         res.was_unknown[p_] = True
+                        self._capture_serial(key[0], key[1], sb)
         else:
             # Count-only sinks stay on the vectorized path permanently:
             # exact totals are guaranteed by drain()'s batched overlap
@@ -1603,6 +1658,7 @@ class TpuAggregator:
             self.metrics["known"] += 1
             return False, False, eh, fields.serial
         bucket.add(fields.serial)
+        self._capture_serial(issuer_idx, eh, fields.serial)
         self.metrics["inserted"] += 1
         if issuer_idx >= self.issuer_totals.shape[0]:
             # Registry-overflow issuers (idx >= MAX_ISSUERS) only ever
@@ -1718,6 +1774,25 @@ class TpuAggregator:
                 with contextlib.suppress(OSError):
                     os.unlink(tmp_path)
                 raise
+            if self.emit_filter_path:
+                self._emit_filter()
+
+    def _emit_filter(self) -> None:
+        """Checkpoint-time filter emission: compile the capture into
+        the versioned artifact (filter/artifact.py) and write it
+        atomically next to the snapshot. An emission failure must not
+        poison the checkpoint that already landed — it is reported and
+        counted, and the next checkpoint retries."""
+        from ct_mapreduce_tpu.filter import artifact as fartifact
+
+        try:
+            art = fartifact.build_from_aggregator(
+                self, fp_rate=self.filter_fp_rate)
+            fartifact.write_artifact(self.emit_filter_path, art.to_bytes())
+        except Exception as err:
+            incr_counter("filter", "emit_error")
+            print(f"filter emission failed ({self.emit_filter_path}): "
+                  f"{type(err).__name__}: {err}", file=sys.stderr)
 
     def _write_npz(self, fh, host_items) -> None:
         layout = ("bucket" if isinstance(self.table, buckettable.BucketTable)
@@ -1738,6 +1813,23 @@ class TpuAggregator:
             slots = rows[:, : buckettable.SLOTS * 5].reshape(-1, 5)
         else:
             slots = rows
+        extra = {}
+        if self.filter_capture is not None:
+            # Filter capture rides the checkpoint ONLY when the feature
+            # is on (round-15 interplay contract: emitFilter off leaves
+            # the .npz byte-identical to pre-round-15 writers). Same
+            # hex-joined encoding as the host-lane sets; sorted keys so
+            # identical captures serialize identically.
+            f_items = sorted(
+                (idx, eh, b";".join(s.hex().encode()
+                                    for s in sorted(serials)))
+                for (idx, eh), serials in self.filter_capture.items()
+            )
+            extra["filter_keys"] = np.array(
+                [(i, e) for i, e, _ in f_items], dtype=np.int64
+            ).reshape(-1, 2)
+            extra["filter_vals"] = np.array(
+                [v for _, _, v in f_items], dtype=object)
         np.savez_compressed(
             fh,
             # (keys, meta, count) stays the cross-version wire format;
@@ -1775,6 +1867,7 @@ class TpuAggregator:
                 dtype=np.uint8,
             ),
             allow_pickle=True,
+            **extra,
         )
 
     def _asarray(self, arr: np.ndarray):
@@ -1866,6 +1959,19 @@ class TpuAggregator:
             int(k): set(v)
             for k, v in json.loads(z["dn_sets"].tobytes().decode()).items()
         }
+        # Filter capture: absent in pre-round-15 snapshots (and any
+        # emitFilter-off writer) → capture stays off; a later
+        # enable_filter_capture() re-seeds from the restored host sets.
+        self.filter_capture = None
+        if "filter_keys" in z:
+            cap: dict[tuple[int, int], set[bytes]] = {}
+            for (idx, eh), blob in zip(
+                    z["filter_keys"].reshape(-1, 2), z["filter_vals"]):
+                cap[(int(idx), int(eh))] = {
+                    bytes.fromhex(h.decode()) for h in blob.split(b";") if h
+                }
+            self.filter_capture = cap
+            self.want_serials = True
 
 
 class HostSnapshotAggregator(TpuAggregator):

@@ -85,6 +85,14 @@ class MergedAggregate:
     def __init__(self) -> None:
         self.registry = IssuerRegistry()
         self.host_serials: dict[tuple[int, int], set[bytes]] = {}
+        # Union of the workers' filter captures (round 15), remapped to
+        # the merged issuer indexing — the seed of the merged fleet
+        # filter artifact (filter/artifact.py::build_from_merged).
+        self.filter_serials: dict[tuple[int, int], set[bytes]] = {}
+        # Checkpoints folded WITHOUT a capture: a merged filter built
+        # over these would silently miss their device-lane serials, so
+        # the builder refuses unless explicitly allowed.
+        self.capture_missing: list[str] = []
         self._snapshots: list[AggregateSnapshot] = []
         self.worker_paths: list[str] = []
 
@@ -102,6 +110,12 @@ class MergedAggregate:
         for (idx, eh), serials in agg.host_serials.items():
             key = (remap[idx], eh)
             self.host_serials.setdefault(key, set()).update(serials)
+        if agg.filter_capture is None:
+            self.capture_missing.append(path)
+        else:
+            for (idx, eh), serials in agg.filter_capture.items():
+                key = (remap[idx], eh)
+                self.filter_serials.setdefault(key, set()).update(serials)
 
     def drain(self) -> AggregateSnapshot:
         return merge_snapshots(self._snapshots)

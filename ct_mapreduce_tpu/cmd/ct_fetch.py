@@ -185,6 +185,7 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: workerId {fleet_worker_id} outside "
               f"[0, numWorkers={num_workers})", file=sys.stderr)
         return 2
+    base_state_path = config.agg_state_path
     config.agg_state_path = worker_state_path(
         config.agg_state_path, fleet_worker_id, num_workers)
     # A durable per-worker checkpoint on disk means this process is a
@@ -220,11 +221,78 @@ def main(argv: list[str] | None = None) -> int:
 
     run_stage = {"stage": "init"}
     sink, model = build_sink(config, database, _backend)
+
+    # Filter emission (round 15): emitFilter compiles the aggregation
+    # state's per-(issuer, expDate) serial sets into a crlite-style
+    # filter-cascade artifact on every checkpoint save. Fleet workers
+    # get per-worker artifact paths (like their snapshots); the leader
+    # additionally emits the MERGED fleet filter each epoch below.
+    from ct_mapreduce_tpu.filter import resolve_filter
+
+    emit_filter, base_filter_path, filter_fp = resolve_filter(
+        config.emit_filter or None, config.filter_path,
+        config.filter_fp_rate, state_path=base_state_path)
+    if emit_filter and model is not None:
+        model.aggregator.configure_filter_emission(
+            worker_state_path(base_filter_path, fleet_worker_id,
+                              num_workers),
+            filter_fp)
+    elif emit_filter:
+        print("emitFilter ignored: filter emission needs backend = tpu",
+              file=sys.stderr)
+        emit_filter = False
+
+    def leader_fleet_filter() -> None:
+        """Leader epoch-tick duty: fold every worker snapshot present
+        on disk (agg/merge.py) and emit the merged fleet filter —
+        best-effort per tick (a worker mid-checkpoint contributes its
+        previous snapshot; the next epoch catches it up)."""
+        if not emit_filter or num_workers <= 1:
+            return
+        if fleet is None or not fleet.is_leader:
+            return
+        from ct_mapreduce_tpu.agg import merge as aggmerge
+        from ct_mapreduce_tpu.filter import artifact as fartifact
+        from ct_mapreduce_tpu.telemetry.metrics import incr_counter
+
+        paths = [
+            p for p in (worker_state_path(base_state_path, w, num_workers)
+                        for w in range(num_workers))
+            if os.path.exists(p)
+        ]
+        if not paths:
+            return
+        try:
+            merged = aggmerge.load_checkpoints(paths)
+            art = fartifact.build_from_merged(
+                merged, fp_rate=filter_fp, allow_partial=True)
+            fartifact.write_artifact(base_filter_path, art.to_bytes())
+            incr_counter("filter", "fleet_emit")
+        except Exception as err:
+            incr_counter("filter", "fleet_emit_error")
+            print(f"fleet filter emission failed: "
+                  f"{type(err).__name__}: {err}", file=sys.stderr)
+
+    def refresh_serve_filter() -> None:
+        """Re-arm the query plane's filter tier from the live capture
+        on the same cadence the artifact is emitted (checkpoint time):
+        the serve tier's cascade snapshot tracks the durable artifact,
+        never drifts unboundedly behind ingest, and between refreshes
+        its registry-snapshot guard forwards anything newer to the
+        table-confirm tier."""
+        if query_server is not None and query_server.oracle.filter_first:
+            try:
+                query_server.oracle.refresh_filter()
+            except Exception:
+                pass  # no capture yet / transient: tier stays as-is
+
     checkpoint_hook = None
     if model is not None and config.agg_state_path:
         # Snapshot device aggregates before every durable cursor write —
         # a crash must never leave the cursor ahead of aggregate state.
-        checkpoint_hook = lambda: sink.checkpointed_save(model.save)  # noqa: E731
+        def checkpoint_hook():
+            sink.checkpointed_save(model.save)
+            refresh_serve_filter()
     engine = LogSyncEngine(
         sink,
         database,
@@ -253,7 +321,8 @@ def main(argv: list[str] | None = None) -> int:
             coordinator,
             checkpoint_period_s=(parse_duration(checkpoint_period)
                                  if checkpoint_period else 0.0),
-            on_checkpoint=lambda epoch: engine.checkpoint_now(),
+            on_checkpoint=lambda epoch: (engine.checkpoint_now(),
+                                         leader_fleet_filter()),
             on_shutdown=lambda reason: (
                 print(f"\nfleet shutdown broadcast: {reason}",
                       file=sys.stderr),
@@ -307,9 +376,14 @@ def main(argv: list[str] | None = None) -> int:
                 model.aggregator, config.query_port,
                 device=config.serve_device,
                 replicas=config.serve_replicas,
-                cache_size=config.serve_cache_size).start()
+                cache_size=config.serve_cache_size,
+                # emitFilter also arms the serve plane's filter-first
+                # tier and the /filter download routes (env
+                # CTMR_SERVE_FILTER_FIRST can still force either way).
+                filter_first=(True if emit_filter else None),
+                filter_fp_rate=filter_fp).start()
             print(f"query endpoint: :{query_server.port}/query "
-                  f"+ /issuer + /getcert", file=sys.stderr)
+                  f"+ /issuer + /getcert + /filter", file=sys.stderr)
         except OSError as err:
             print(f"query endpoint disabled: {err}", file=sys.stderr)
             query_server = None
@@ -415,6 +489,7 @@ def main(argv: list[str] | None = None) -> int:
             if model is not None:
                 run_stage["stage"] = "saving"
                 model.save()
+                refresh_serve_filter()
             if fleet is not None:
                 # This round's entries are durably folded: drop the
                 # fetch leases so next round's rightful owners (per the

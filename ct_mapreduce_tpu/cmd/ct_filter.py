@@ -1,0 +1,178 @@
+"""ct-filter: build, inspect, and query revocation-filter artifacts
+offline from aggregate checkpoints — no running ct-fetch needed.
+
+The CLI face of :mod:`ct_mapreduce_tpu.filter` (round 15):
+
+    ct-filter build -state agg.npz[,agg.w*.npz] -out run.filter \\
+              [-fpRate 0.01] [-allowPartial]
+    ct-filter inspect -artifact run.filter [-json]
+    ct-filter query -artifact run.filter -issuer <issuerID> \\
+              -expDate 2031-06-15-14 -serial 4d0000002a [-serial ...]
+
+``build`` folds one or many worker checkpoints (comma list and globs,
+the ``aggStatePath`` spelling) through the fleet merge
+(:mod:`ct_mapreduce_tpu.agg.merge`) so a single snapshot and a whole
+fleet's worth compile identically — the merged artifact of a W-worker
+fleet is byte-identical to the serial run's. Checkpoints written with
+``emitFilter`` off carry no serial bytes for their device lanes and are
+refused unless ``-allowPartial`` accepts a filter over the capturing
+subset.
+
+Exit status: ``build``/``inspect`` 0 on success; ``query`` 0 when every
+serial is known, 1 when any is unknown, 2 on usage/format errors —
+scriptable like ``ct-query``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _build(args, out) -> int:
+    from ct_mapreduce_tpu.agg import merge
+    from ct_mapreduce_tpu.filter import (
+        build_from_merged,
+        write_artifact,
+    )
+
+    paths = merge.expand_state_paths(args.state)
+    if not paths:
+        print(f"error: no checkpoints match {args.state!r}",
+              file=sys.stderr)
+        return 2
+    try:
+        merged = merge.load_checkpoints(paths)
+    except FileNotFoundError as err:
+        print(f"error: checkpoint not found: {err}", file=sys.stderr)
+        return 2
+    try:
+        art = build_from_merged(merged, fp_rate=args.fpRate,
+                                allow_partial=args.allowPartial)
+    except ValueError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    blob = art.to_bytes()
+    write_artifact(args.out, blob)
+    print(json.dumps({
+        "out": args.out,
+        "bytes": len(blob),
+        "checkpoints": paths,
+        "serials": art.n_serials,
+        "groups": len(art.groups),
+        "max_layers": art.max_layers(),
+        "bits_per_entry": round(art.bits_per_entry(), 3),
+        "fp_rate": art.fp_rate,
+    }, indent=2), file=out)
+    return 0
+
+
+def _inspect(args, out) -> int:
+    from ct_mapreduce_tpu.filter import read_artifact
+
+    art = read_artifact(args.artifact)
+    groups = [
+        {
+            "issuer": g.issuer,
+            "expDate": g.exp_id,
+            "serials": g.n,
+            "layers": [{"m": lyr.m, "k": lyr.k}
+                       for lyr in g.cascade.layers],
+            "bits_per_entry": round(g.cascade.bits_per_entry(), 3),
+        }
+        for _, g in sorted(art.groups.items())
+    ]
+    body = {
+        "fp_rate": art.fp_rate,
+        "serials": art.n_serials,
+        "groups": len(groups),
+        "max_layers": art.max_layers(),
+        "bits_per_entry": round(art.bits_per_entry(), 3),
+    }
+    if args.json:
+        body["group_detail"] = groups
+        print(json.dumps(body, indent=2), file=out)
+        return 0
+    print(json.dumps(body, indent=2), file=out)
+    for g in groups:
+        layers = "+".join(str(lyr["m"]) for lyr in g["layers"])
+        print(f"{g['issuer']} {g['expDate']}: {g['serials']} serials, "
+              f"{len(g['layers'])} layers ({layers} bits)", file=out)
+    return 0
+
+
+def _query(args, out) -> int:
+    from ct_mapreduce_tpu.filter import read_artifact
+
+    art = read_artifact(args.artifact)
+    try:
+        serials = [bytes.fromhex(s) for s in args.serial]
+    except ValueError as err:
+        print(f"error: serial is not hex: {err}", file=sys.stderr)
+        return 2
+    all_known = True
+    for raw, sb in zip(args.serial, serials):
+        known = art.query(args.issuer, args.expDate, sb)
+        all_known &= known
+        print(json.dumps({"issuer": args.issuer, "expDate": args.expDate,
+                          "serial": raw, "known": known}), file=out)
+    return 0 if all_known else 1
+
+
+def main(argv: list[str] | None = None, out=None) -> int:
+    parser = argparse.ArgumentParser(prog="ct-filter")
+    sub = parser.add_subparsers(dest="cmd")
+
+    b = sub.add_parser("build", help="compile checkpoints → artifact")
+    b.add_argument("-state", "--state", required=True,
+                   help="checkpoint path(s): comma list, globs ok "
+                        "(the aggStatePath spelling)")
+    b.add_argument("-out", "--out", required=True,
+                   help="artifact output path")
+    b.add_argument("-fpRate", "--fpRate", type=float, default=0.01,
+                   help="target layer-0 false-positive rate")
+    b.add_argument("-allowPartial", "--allowPartial", action="store_true",
+                   help="accept checkpoints without a filter capture "
+                        "(their device-lane serials will be missing)")
+
+    i = sub.add_parser("inspect", help="artifact → structure summary")
+    i.add_argument("-artifact", "--artifact", required=True)
+    i.add_argument("-json", "--json", action="store_true",
+                   help="full per-group detail as JSON")
+
+    q = sub.add_parser("query", help="offline membership question")
+    q.add_argument("-artifact", "--artifact", required=True)
+    q.add_argument("-issuer", "--issuer", required=True,
+                   help="issuerID (base64url of SHA-256(SPKI))")
+    q.add_argument("-expDate", "--expDate", required=True,
+                   help="expiration bucket id, e.g. 2031-06-15-14")
+    q.add_argument("-serial", "--serial", action="append", default=[],
+                   help="serial content bytes as hex (repeatable)")
+
+    args = parser.parse_args(argv)
+    out = out or sys.stdout
+    if args.cmd == "build":
+        return _build(args, out)
+    if args.cmd == "inspect":
+        try:
+            return _inspect(args, out)
+        except (OSError, ValueError) as err:
+            print(f"error: {err}", file=sys.stderr)
+            return 2
+    if args.cmd == "query":
+        if not args.serial:
+            print("error: at least one -serial is required",
+                  file=sys.stderr)
+            return 2
+        try:
+            return _query(args, out)
+        except (OSError, ValueError) as err:
+            print(f"error: {err}", file=sys.stderr)
+            return 2
+    parser.print_usage(sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

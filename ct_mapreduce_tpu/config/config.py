@@ -98,6 +98,13 @@ class CTConfig:
     coordinator_backend: str = ""  # fleet coordination fabric:
     # redis | jax | solo ("" = CTMR_COORDINATOR env, then redis when
     # numWorkers > 1, else solo)
+    emit_filter: bool = False  # compile crlite-style filter artifacts
+    # from the aggregation state at checkpoint time (CTMR_EMIT_FILTER
+    # equivalent; tpu backend only)
+    filter_path: str = ""  # filter artifact output path
+    # ("" = CTMR_FILTER_PATH env, then <aggStatePath>.filter)
+    filter_fp_rate: float = 0.0  # target layer-0 false-positive rate
+    # (0 = CTMR_FILTER_FP_RATE env, then 0.01)
     verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
@@ -148,6 +155,9 @@ class CTConfig:
         "workerId": ("worker_id", int),
         "checkpointPeriod": ("checkpoint_period", str),
         "coordinatorBackend": ("coordinator_backend", str),
+        "emitFilter": ("emit_filter", bool),
+        "filterPath": ("filter_path", str),
+        "filterFpRate": ("filter_fp_rate", float),
     }
 
     @classmethod
@@ -339,6 +349,17 @@ class CTConfig:
             "coordinatorBackend = fleet coordination fabric: redis | "
             "jax | solo (CTMR_COORDINATOR equivalent; default redis "
             "when numWorkers > 1)",
+            "emitFilter = compile a crlite-style filter-cascade "
+            "artifact from the per-(issuer, expDate) known-serial "
+            "sets on every checkpoint save (CTMR_EMIT_FILTER "
+            "equivalent; a fleet leader also emits the merged fleet "
+            "filter each epoch)",
+            "filterPath = filter artifact output path "
+            "(CTMR_FILTER_PATH equivalent; default "
+            "<aggStatePath>.filter, per-worker suffixed in a fleet)",
+            "filterFpRate = target layer-0 false-positive rate of the "
+            "filter cascade (CTMR_FILTER_FP_RATE equivalent; default "
+            "0.01; included serials are exact regardless)",
         ]
         return "\n".join(lines)
 

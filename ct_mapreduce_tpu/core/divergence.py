@@ -1,0 +1,174 @@
+"""Parser-divergence classification: the standing differential
+harness seeded from the mutation fuzzers (ROADMAP item 5(a), after
+ParsEval, arxiv 2405.18993).
+
+Three parsers cover the same identity surface in this tree — the
+device DER walker (:mod:`ct_mapreduce_tpu.ops.der_kernel`), the native
+scalar sidecar extractor (:mod:`ct_mapreduce_tpu.native.leafpack`),
+and the strict host parser (:mod:`ct_mapreduce_tpu.core.der`).
+``classify_corpus`` runs a byte corpus through all of them and files
+every certificate into the divergence buckets the fuzz suites (and a
+future adversarial-corpus harness) report on:
+
+- **device-accepts / host-rejects** — the walker's bounded leniency
+  (it skips subtrees outside the identity surface, like Go x509's
+  non-fatal tolerance). Bounded, never silently wrong: identity bytes
+  are validated by the walker itself.
+- **host-accepts / device-rejects** — walker strictness; these lanes
+  take the exact host lane at ingest, so they cost throughput, not
+  correctness.
+- **verdict-mismatch** — both parsers accept but an identity-surface
+  field differs (serial window, expiry hour, CA flag, SPKI window,
+  issuer Name window, issuer-CN bytes, CRLDP presence/URLs). The
+  HARD bucket: anything here silently corrupts identity keys and
+  must stay at zero.
+- **sidecar-undecidable** — the native extractor's ok bit disagrees
+  with the walker's (either direction). The pre-parsed lane replays
+  such lanes through the walker, so this bucket costs routing, not
+  correctness — but drift here is the first sign the two ports have
+  diverged.
+
+``publish`` turns a report into the tracked metrics
+(``parse.device_accept_rate`` and the ``parse.divergence_*`` counters,
+docs/METRICS.md) so a long-running differential harness trends them.
+
+The module imports lazily: ``core/`` stays jax-free until a corpus is
+actually classified.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ct_mapreduce_tpu.telemetry.metrics import incr_counter, set_gauge
+
+
+@dataclass
+class DivergenceReport:
+    total: int = 0
+    device_accepts: int = 0
+    host_accepts: int = 0
+    both_accept: int = 0
+    device_accept_host_reject: int = 0
+    host_accept_device_reject: int = 0
+    verdict_mismatch: int = 0
+    # -1 = native extractor unavailable (bucket not measured).
+    sidecar_undecidable: int = -1
+    # Reproduction material for the non-empty hard buckets: one line
+    # per offender, capped so a pathological corpus cannot flood.
+    details: list[str] = field(default_factory=list)
+
+    @property
+    def device_accept_rate(self) -> float:
+        return self.device_accepts / max(1, self.total)
+
+
+def _walker_fields_mismatch(der: bytes, out, i: int, ref) -> str | None:
+    """Identity-surface compare for one walker-accepted lane against
+    the strict host parse; returns a repro string on mismatch."""
+    from ct_mapreduce_tpu.core import der as hostder
+
+    cn_bytes = der[int(out.issuer_cn_off[i]):
+                   int(out.issuer_cn_off[i]) + int(out.issuer_cn_len[i])]
+    try:  # mirror the host's utf-8-then-latin-1 decode (der.py)
+        cn_str = cn_bytes.decode("utf-8")
+    except UnicodeDecodeError:
+        cn_str = cn_bytes.decode("latin-1")
+    if bool(out.has_crldp[i]):
+        try:
+            dev_urls = hostder._parse_crldp(der, int(out.crldp_off[i]))
+        except Exception:
+            dev_urls = ["<unparseable>"]
+    else:
+        dev_urls = []
+    if (int(out.serial_off[i]) != ref.serial_off
+            or int(out.serial_len[i]) != ref.serial_len
+            or int(out.not_after_hour[i]) != ref.not_after_unix_hour
+            or bool(out.is_ca[i]) != ref.is_ca
+            or int(out.spki_off[i]) != ref.spki_off
+            or int(out.spki_len[i]) != ref.spki_len
+            or int(out.issuer_off[i]) != ref.issuer_off
+            or int(out.issuer_len[i]) != ref.issuer_len
+            or cn_str != ref.issuer_cn
+            or bool(out.has_crldp[i]) != bool(ref.crl_distribution_points)
+            or sorted(dev_urls) != sorted(ref.crl_distribution_points)):
+        return (
+            f"lane {i} dev=(so={int(out.serial_off[i])} "
+            f"sl={int(out.serial_len[i])} "
+            f"nah={int(out.not_after_hour[i])} ca={bool(out.is_ca[i])} "
+            f"po={int(out.spki_off[i])} pl={int(out.spki_len[i])}) "
+            f"host=(so={ref.serial_off} sl={ref.serial_len} "
+            f"nah={ref.not_after_unix_hour} ca={ref.is_ca} "
+            f"po={ref.spki_off} pl={ref.spki_len}) der={der.hex()}"
+        )
+    return None
+
+
+def classify_corpus(ders: list[bytes], pad_to: int = 1024,
+                    max_details: int = 20) -> DivergenceReport:
+    """Run every parser over the corpus and fill the buckets. Entries
+    longer than ``pad_to`` are the caller's problem (route them to a
+    wider bucket first, like the ingest path does)."""
+    from ct_mapreduce_tpu.core import der as hostder
+    from ct_mapreduce_tpu.ops import der_kernel
+
+    n = len(ders)
+    data = np.zeros((n, pad_to), np.uint8)
+    length = np.zeros((n,), np.int32)
+    for i, d in enumerate(ders):
+        data[i, : len(d)] = np.frombuffer(d, np.uint8)
+        length[i] = len(d)
+    out = der_kernel.parse_certs(data, length)
+    ok = np.asarray(out.ok)
+
+    report = DivergenceReport(total=n)
+    report.device_accepts = int(ok.sum())
+    for i, der in enumerate(ders):
+        try:
+            ref = hostder.parse_cert(der)
+        except Exception:
+            ref = None
+        if ref is not None:
+            report.host_accepts += 1
+        if ok[i] and ref is None:
+            report.device_accept_host_reject += 1
+        elif not ok[i] and ref is not None:
+            report.host_accept_device_reject += 1
+        elif ok[i] and ref is not None:
+            report.both_accept += 1
+            repro = _walker_fields_mismatch(der, out, i, ref)
+            if repro is not None:
+                report.verdict_mismatch += 1
+                if len(report.details) < max_details:
+                    report.details.append("MISMATCH " + repro)
+
+    try:
+        from ct_mapreduce_tpu.native import available, leafpack
+
+        native_ok = available()
+    except Exception:
+        native_ok = False
+    if native_ok:
+        sc = leafpack.extract_sidecars(data, length)
+        sc_ok = np.asarray(sc.ok).astype(bool)
+        report.sidecar_undecidable = int((sc_ok ^ ok).sum())
+    return report
+
+
+def publish(report: DivergenceReport) -> None:
+    """Emit the tracked metrics for one classified corpus. Counters
+    accumulate across corpora; the accept-rate gauge reflects the
+    latest corpus (the number dashboards trend across fuzz rounds)."""
+    set_gauge("parse", "device_accept_rate",
+              value=report.device_accept_rate)
+    incr_counter("parse", "divergence_device_accept_host_reject",
+                 value=float(report.device_accept_host_reject))
+    incr_counter("parse", "divergence_host_accept_device_reject",
+                 value=float(report.host_accept_device_reject))
+    incr_counter("parse", "divergence_verdict_mismatch",
+                 value=float(report.verdict_mismatch))
+    if report.sidecar_undecidable >= 0:
+        incr_counter("parse", "divergence_sidecar_undecidable",
+                     value=float(report.sidecar_undecidable))

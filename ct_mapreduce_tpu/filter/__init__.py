@@ -1,0 +1,72 @@
+"""Filter emission: crlite-style compact revocation-filter artifacts
+compiled from the aggregation state (ROADMAP item 5(b), round 15).
+
+- :mod:`ct_mapreduce_tpu.filter.cascade` — the Bloom filter-cascade
+  primitive (exact membership over the observed universe, device-built
+  layers with a host fallback lane).
+- :mod:`ct_mapreduce_tpu.filter.artifact` — canonical keys, the
+  versioned on-disk format (docs/FILTER_FORMAT.md), and the builders
+  over live aggregators / merged fleet checkpoints.
+
+``resolve_filter`` is the config surface: ``emitFilter`` /
+``filterPath`` / ``filterFpRate`` directives with ``CTMR_EMIT_FILTER``
+/ ``CTMR_FILTER_PATH`` / ``CTMR_FILTER_FP_RATE`` env equivalents.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ct_mapreduce_tpu.filter.artifact import (  # noqa: F401
+    DEFAULT_FP_RATE,
+    FilterArtifact,
+    build_artifact,
+    build_from_aggregator,
+    build_from_merged,
+    canonical_keys,
+    read_artifact,
+    write_artifact,
+)
+from ct_mapreduce_tpu.filter.cascade import (  # noqa: F401
+    BloomLayer,
+    FilterCascade,
+)
+
+
+def resolve_filter(emit=None, path: str = "", fp_rate: float = 0.0,
+                   state_path: str = "") -> tuple[bool, str, float]:
+    """Resolve the filter-emission knobs: explicit value (config
+    directive / kwarg) > ``CTMR_EMIT_FILTER`` / ``CTMR_FILTER_PATH`` /
+    ``CTMR_FILTER_FP_RATE`` env > defaults (off; ``<aggStatePath>
+    .filter``; 0.01 target FP rate). Unparseable env values are
+    ignored, matching the config layer's tolerance."""
+    if emit is None:
+        ev = os.environ.get("CTMR_EMIT_FILTER", "").strip().lower()
+        emit = ev in ("1", "t", "true")
+    p = path or os.environ.get("CTMR_FILTER_PATH", "")
+    if not p and state_path:
+        p = state_path + ".filter"
+    r = float(fp_rate or 0.0)
+    if r <= 0:
+        try:
+            r = float(os.environ.get("CTMR_FILTER_FP_RATE", "") or 0.0)
+        except ValueError:
+            r = 0.0
+    if r <= 0:
+        r = DEFAULT_FP_RATE
+    return bool(emit), p, r
+
+
+__all__ = [
+    "DEFAULT_FP_RATE",
+    "BloomLayer",
+    "FilterArtifact",
+    "FilterCascade",
+    "build_artifact",
+    "build_from_aggregator",
+    "build_from_merged",
+    "canonical_keys",
+    "read_artifact",
+    "resolve_filter",
+    "write_artifact",
+]

@@ -1,0 +1,381 @@
+"""The on-disk revocation-filter artifact: versioned, deterministic,
+one filter cascade per ``(issuer, expDate)`` known-serial set.
+
+This is the second product of the reduce state (ROADMAP item 5(b)):
+where ``storage-statistics`` prints the per-(issuer, expDate) serial
+*counts*, the filter artifact compiles the serial *sets* — captured by
+the aggregator's filter capture (:meth:`TpuAggregator.
+enable_filter_capture`) — into compact crlite-style cascades a
+downstream revocation pipeline can ship. Byte layout is specified in
+docs/FILTER_FORMAT.md; the invariants that matter here:
+
+- **Canonical keys.** Element keys are the pipeline's own fingerprint
+  message (``expHour ‖ issuerOrdinal ‖ serialLen ‖ serial``, SHA-256,
+  low 128 bits) with the issuer's run-local registry index replaced by
+  its ORDINAL in the artifact's sorted issuerID list. Run-local
+  indices differ between a fleet's workers and a serial run; sorted
+  identities do not — this is what makes a merged fleet artifact
+  byte-identical to the serial run's (tools/fleet.py --verify).
+  Conforming serials (≤ MAX_SERIAL_BYTES) hash through the existing
+  kernels — the jitted :func:`ops.pipeline.fingerprints` for large
+  batches, the :func:`core.packing.fingerprints_np` host mirror
+  otherwise; oversized serials take a host hashlib lane with a
+  disjoint message encoding (the walker-fallback pattern).
+- **Determinism.** Groups sort by (issuerID, expHour), serials sort
+  bytewise, layer sizing is a fixed formula, headers are
+  sorted-key/compact JSON, and no wall-clock enters the bytes: the
+  same aggregation state always serializes to the same artifact.
+- **Exactness.** Each group's cascade is built with *every other
+  group's keys* as its excluded universe, so any serial known to the
+  aggregation state answers its (issuer, expDate) membership exactly;
+  serials outside the state see ≈ the target FP rate and are killed
+  by the serve plane's table-confirm tier.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import tempfile
+from dataclasses import dataclass
+
+import numpy as np
+
+from ct_mapreduce_tpu.core import packing
+from ct_mapreduce_tpu.core.types import ExpDate
+from ct_mapreduce_tpu.filter.cascade import (
+    DEVICE_BUILD_MIN,
+    BloomLayer,
+    FilterCascade,
+    device_enabled,
+)
+from ct_mapreduce_tpu.telemetry import trace
+from ct_mapreduce_tpu.telemetry.metrics import incr_counter, measure, set_gauge
+
+MAGIC = b"CTMRFL01"
+VERSION = 1
+DEFAULT_FP_RATE = 0.01
+
+_jit_cache: dict = {}
+
+
+def _fingerprints_jit():
+    fn = _jit_cache.get("fp")
+    if fn is None:
+        import jax
+
+        from ct_mapreduce_tpu.ops import pipeline
+
+        fn = jax.jit(pipeline.fingerprints)
+        _jit_cache["fp"] = fn
+    return fn
+
+
+def canonical_keys(ordinals: np.ndarray, exp_hours: np.ndarray,
+                   serials: list[bytes],
+                   use_device: bool | None = None) -> np.ndarray:
+    """uint32[n, 4] canonical filter keys for (ordinal, expHour,
+    serial) triples. Conforming serials reuse the pipeline fingerprint
+    kernels (device when the batch is large, the vectorized host
+    mirror otherwise); oversized serials — host-lane-only identities —
+    hash through a disjoint single-purpose encoding that no conforming
+    message can collide with (marker byte 0xFF > MAX_SERIAL_BYTES in
+    the length position)."""
+    n = len(serials)
+    out = np.zeros((n, 4), np.uint32)
+    if n == 0:
+        return out
+    ordinals = np.asarray(ordinals, np.int64)
+    exp_hours = np.asarray(exp_hours, np.int64)
+    lens = np.fromiter((len(s) for s in serials), np.int64, n)
+    fit = lens <= packing.MAX_SERIAL_BYTES
+    sel = np.nonzero(fit)[0]
+    if sel.size:
+        mat = np.zeros((sel.size, packing.MAX_SERIAL_BYTES), np.uint8)
+        for j, p in enumerate(sel):
+            sb = serials[p]
+            mat[j, : len(sb)] = np.frombuffer(sb, np.uint8)
+        if use_device is None:
+            use_device = device_enabled() and sel.size >= DEVICE_BUILD_MIN
+        if use_device:
+            import jax.numpy as jnp
+
+            with trace.span("filter.fingerprint", cat="filter",
+                            lanes=int(sel.size), device=1):
+                fps = np.asarray(_fingerprints_jit()(
+                    jnp.asarray(ordinals[sel].astype(np.int32)),
+                    jnp.asarray(exp_hours[sel].astype(np.int32)),
+                    jnp.asarray(mat),
+                    jnp.asarray(lens[sel].astype(np.int32)),
+                ))
+        else:
+            fps = packing.fingerprints_np(
+                ordinals[sel], exp_hours[sel], mat, lens[sel])
+        out[sel] = fps
+    for p in np.nonzero(~fit)[0]:
+        sb = serials[p]
+        msg = (
+            int(exp_hours[p]).to_bytes(4, "big", signed=True)
+            + int(ordinals[p]).to_bytes(4, "big")
+            + b"\xff"
+            + len(sb).to_bytes(4, "big")
+            + sb
+        )
+        digest = hashlib.sha256(msg).digest()
+        out[p] = [int.from_bytes(digest[16 + 4 * i: 20 + 4 * i], "big")
+                  for i in range(4)]
+    return out
+
+
+@dataclass
+class FilterGroup:
+    issuer: str  # issuerID (base64url(SHA-256(SPKI)))
+    exp_id: str  # expDate report id, e.g. "2031-06-15-14"
+    exp_hour: int
+    ordinal: int  # issuer ordinal the keys were hashed under
+    n: int  # included serials
+    cascade: FilterCascade
+
+
+class FilterArtifact:
+    """Parsed (or freshly built) artifact: group directory + cascades."""
+
+    def __init__(self, fp_rate: float, groups: list[FilterGroup]):
+        self.fp_rate = float(fp_rate)
+        self.groups = {(g.issuer, g.exp_id): g for g in groups}
+        self._by_hour = {(g.issuer, g.exp_hour): g for g in groups}
+
+    @property
+    def n_serials(self) -> int:
+        return sum(g.n for g in self.groups.values())
+
+    def max_layers(self) -> int:
+        return max((len(g.cascade.layers) for g in self.groups.values()),
+                   default=0)
+
+    def total_bits(self) -> int:
+        return sum(g.cascade.total_bits() for g in self.groups.values())
+
+    def bits_per_entry(self) -> float:
+        return self.total_bits() / max(1, self.n_serials)
+
+    # -- queries ---------------------------------------------------------
+    def group_for(self, issuer: str, exp) -> FilterGroup | None:
+        """Group lookup; ``exp`` is an expDate id string or epoch
+        hour. String ids resolve through ExpDate.parse so day- and
+        hour-form spellings of the same bucket both land."""
+        if isinstance(exp, str):
+            g = self.groups.get((issuer, exp))
+            if g is not None:
+                return g
+            try:
+                exp = ExpDate.parse(exp).unix_hour()
+            except ValueError:
+                return None
+        return self._by_hour.get((issuer, int(exp)))
+
+    def query(self, issuer: str, exp, serial: bytes) -> bool:
+        """Is ``serial`` a known serial of (issuer, expDate)? Exact
+        for every serial the source aggregation state knew; unknown
+        serials see ≈ the target FP rate (confirm against the table
+        before trusting a positive)."""
+        g = self.group_for(issuer, exp)
+        if g is None:
+            return False
+        keys = canonical_keys(
+            np.array([g.ordinal]), np.array([g.exp_hour]), [serial])
+        return bool(g.cascade.contains(keys)[0])
+
+    def query_group(self, g: FilterGroup, serials: list[bytes]) -> np.ndarray:
+        keys = canonical_keys(
+            np.full((len(serials),), g.ordinal),
+            np.full((len(serials),), g.exp_hour), serials)
+        return g.cascade.contains(keys)
+
+    # -- serialization ---------------------------------------------------
+    def to_bytes(self) -> bytes:
+        payload = bytearray()
+        entries = []
+        for (_, _), g in sorted(self.groups.items()):
+            layers = []
+            for layer in g.cascade.layers:
+                raw = layer.words.astype("<u4").tobytes()
+                layers.append({"k": layer.k, "m": layer.m,
+                               "off": len(payload), "words": len(raw)})
+                payload += raw
+            entries.append({
+                "expDate": g.exp_id, "expHour": g.exp_hour,
+                "issuer": g.issuer, "layers": layers, "n": g.n,
+                "ordinal": g.ordinal,
+            })
+        header = json.dumps(
+            {"fpRate": self.fp_rate, "groups": entries,
+             "nSerials": self.n_serials, "version": VERSION},
+            sort_keys=True, separators=(",", ":")).encode()
+        return MAGIC + struct.pack("<I", len(header)) + header + bytes(payload)
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "FilterArtifact":
+        if blob[:8] != MAGIC:
+            raise ValueError("not a ct-mapreduce filter artifact "
+                             f"(magic {blob[:8]!r})")
+        (hlen,) = struct.unpack("<I", blob[8:12])
+        header = json.loads(blob[12:12 + hlen].decode())
+        if header.get("version") != VERSION:
+            raise ValueError(
+                f"unsupported filter artifact version "
+                f"{header.get('version')!r} (this build reads {VERSION})")
+        payload = blob[12 + hlen:]
+        groups = []
+        for e in header["groups"]:
+            layers = []
+            for lyr in e["layers"]:
+                raw = payload[lyr["off"]: lyr["off"] + lyr["words"]]
+                words = np.frombuffer(raw, dtype="<u4").astype(np.uint32)
+                layers.append(BloomLayer(m=lyr["m"], k=lyr["k"],
+                                         words=words))
+            groups.append(FilterGroup(
+                issuer=e["issuer"], exp_id=e["expDate"],
+                exp_hour=int(e["expHour"]), ordinal=int(e["ordinal"]),
+                n=int(e["n"]),
+                cascade=FilterCascade(fp_rate=header["fpRate"],
+                                      n_included=int(e["n"]),
+                                      layers=layers)))
+        return cls(fp_rate=header["fpRate"], groups=groups)
+
+    def group_bytes(self, issuer: str, exp) -> bytes | None:
+        """A standalone single-group artifact (same format) for the
+        serve plane's per-(issuer, expDate) download route. The group
+        keeps its full-artifact ordinal and its cascade was built
+        against the GLOBAL excluded universe, so the slice answers
+        exactly what the full artifact answers."""
+        g = self.group_for(issuer, exp)
+        if g is None:
+            return None
+        return FilterArtifact(self.fp_rate, [g]).to_bytes()
+
+
+def build_artifact(serial_sets: dict, fp_rate: float = DEFAULT_FP_RATE,
+                   use_device: bool | None = None) -> FilterArtifact:
+    """Compile ``{(issuerID, expHour): iterable of serial bytes}`` into
+    a deterministic artifact: each group's cascade includes its own
+    serials and excludes every other group's keys."""
+    with measure("filter", "build_s"), \
+            trace.span("filter.build", cat="filter",
+                       groups=len(serial_sets)):
+        group_keys = sorted(serial_sets)
+        issuers = sorted({iss for iss, _ in group_keys})
+        ordinal = {iss: i for i, iss in enumerate(issuers)}
+        ords, ehs, flat = [], [], []
+        bounds = []
+        for iss, eh in group_keys:
+            serials = sorted(set(serial_sets[(iss, eh)]))
+            start = len(flat)
+            flat.extend(serials)
+            ords.extend([ordinal[iss]] * len(serials))
+            ehs.extend([eh] * len(serials))
+            bounds.append((iss, eh, start, len(flat)))
+        all_keys = canonical_keys(
+            np.asarray(ords, np.int64), np.asarray(ehs, np.int64), flat,
+            use_device=use_device)
+        groups = []
+        for iss, eh, start, end in bounds:
+            if end == start:
+                continue
+            mask = np.zeros((len(flat),), bool)
+            mask[start:end] = True
+            cascade = FilterCascade.build(
+                all_keys[mask], all_keys[~mask], fp_rate,
+                use_device=use_device)
+            groups.append(FilterGroup(
+                issuer=iss, exp_id=ExpDate.from_unix_hour(eh).id(),
+                exp_hour=eh, ordinal=ordinal[iss],
+                n=end - start, cascade=cascade))
+        art = FilterArtifact(fp_rate=fp_rate, groups=groups)
+    set_gauge("filter", "serials", value=float(art.n_serials))
+    set_gauge("filter", "groups", value=float(len(art.groups)))
+    set_gauge("filter", "layers", value=float(art.max_layers()))
+    set_gauge("filter", "bits_per_entry", value=art.bits_per_entry())
+    return art
+
+
+def capture_by_identity(capture: dict, registry) -> dict:
+    """Aggregator filter capture ({(issuer_idx, expHour): serial set})
+    → identity-keyed serial sets ({(issuerID, expHour): set}). Indices
+    past the registry (impossible in a consistent state) fail loudly —
+    an artifact must never silently drop a group."""
+    out: dict = {}
+    for (idx, eh), serials in capture.items():
+        if not serials:
+            continue
+        iss = registry.issuer_at(int(idx)).id()
+        out.setdefault((iss, int(eh)), set()).update(serials)
+    return out
+
+
+def build_from_aggregator(agg, fp_rate: float = DEFAULT_FP_RATE,
+                          use_device: bool | None = None) -> FilterArtifact:
+    """Artifact from a live aggregator's filter capture."""
+    if getattr(agg, "filter_capture", None) is None:
+        raise ValueError(
+            "aggregator has no filter capture; enable emitFilter (or "
+            "call enable_filter_capture) before ingesting")
+    # Snapshot under the fold lock: a live serve-plane refresh may run
+    # while ingest folds mutate the capture dict/sets concurrently.
+    import contextlib
+
+    lock = getattr(agg, "_fold_lock", None)
+    with (lock if lock is not None else contextlib.nullcontext()):
+        capture = {key: set(serials)
+                   for key, serials in agg.filter_capture.items()}
+    return build_artifact(
+        capture_by_identity(capture, agg.registry),
+        fp_rate=fp_rate, use_device=use_device)
+
+
+def build_from_merged(merged, fp_rate: float = DEFAULT_FP_RATE,
+                      allow_partial: bool = False,
+                      use_device: bool | None = None) -> FilterArtifact:
+    """Artifact from a fleet's merged checkpoints
+    (:class:`ct_mapreduce_tpu.agg.merge.MergedAggregate`). Every folded
+    checkpoint must carry a filter capture (a worker that ran with
+    emitFilter off contributes device-lane serials only as hashes —
+    unrecoverable), unless ``allow_partial`` explicitly accepts an
+    artifact over the capturing subset."""
+    missing = getattr(merged, "capture_missing", [])
+    if missing and not allow_partial:
+        raise ValueError(
+            "merged checkpoints without a filter capture (run workers "
+            f"with emitFilter=true): {missing}")
+    return build_artifact(
+        capture_by_identity(merged.filter_serials, merged.registry),
+        fp_rate=fp_rate, use_device=use_device)
+
+
+def write_artifact(path: str, blob: bytes) -> None:
+    """Atomic artifact write (temp + rename — the same durability
+    contract as the aggregate checkpoint: a crash mid-write must not
+    corrupt the previous good artifact)."""
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp_path = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".tmp.", dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp_path, path)
+    except BaseException:
+        import contextlib
+
+        with contextlib.suppress(OSError):
+            os.unlink(tmp_path)
+        raise
+    incr_counter("filter", "emit")
+
+
+def read_artifact(path: str) -> FilterArtifact:
+    with open(path, "rb") as fh:
+        return FilterArtifact.from_bytes(fh.read())

@@ -1,0 +1,270 @@
+"""Bloom filter-cascade primitives: the crlite-style exact-membership
+structure compiled from the aggregation state (ROADMAP item 5(b)).
+
+A cascade over an *included* key set I and an *excluded* key set X
+(both ``uint32[n, 4]`` fingerprint rows, disjoint) is a list of Bloom
+layers: layer 0 holds I at the target false-positive rate, layer 1
+holds the members of X that layer 0 false-positives on, layer 2 the
+members of I that layer 1 false-positives on, and so on until a layer
+produces no false positives against its complement set. Querying walks
+the layers; the index of the first missing layer decides (odd ⇒
+included), and a key passing every layer is decided by the layer-count
+parity. By construction every key of I ∪ X is answered EXACTLY —
+included keys can never answer excluded — while keys outside both sets
+see roughly the layer-0 false-positive rate (the serve plane's
+table-confirm tier kills those).
+
+Layer hashing reuses the pipeline's fingerprint discipline: element
+keys are SHA-256 fingerprints (``core.packing.fingerprints_np`` host
+mirror / the jitted ``ops.pipeline.fingerprints`` device path — see
+:mod:`ct_mapreduce_tpu.filter.artifact`), and probe positions derive
+from the key words by Kirsch-Mitzenmacher double hashing in wrapping
+uint32 arithmetic, identical on device (jnp) and host (np) so the
+device-built and host-built bitmaps are bit-equal. The device build
+bit-scatters each layer into a bitmap in one jitted execution and the
+bitmap is packed into little-endian ``uint32`` words host-side; small
+layers (or ``CTMR_FILTER_DEVICE=0``) take the pure-NumPy lane — the
+walker-fallback pattern applied to filter building.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ct_mapreduce_tpu.telemetry import trace
+
+# Knuth multiplicative-hash constants used to decorrelate layers: the
+# key words are already uniform (SHA-256 output), the layer index is
+# not — mixing it through these keeps layer ℓ's probes independent of
+# layer ℓ+2's over the same keys.
+_GOLD = np.uint32(0x9E3779B9)
+_MIX = np.uint32(0x85EBCA6B)
+
+# Below this many keys a layer builds on the host: the jit dispatch +
+# readback overhead dwarfs the work (same threshold reasoning as the
+# aggregator's padded contains probes).
+DEVICE_BUILD_MIN = 4096
+
+# A cascade that has not converged after this many layers indicates
+# either non-disjoint inputs or a pathological fingerprint cluster;
+# fail loudly rather than looping.
+MAX_LAYERS = 64
+
+
+def device_enabled() -> bool:
+    """Filter layers may use the jitted build path (CTMR_FILTER_DEVICE:
+    0 forces the host lane, 1 forces device even for tiny layers)."""
+    v = os.environ.get("CTMR_FILTER_DEVICE", "").strip().lower()
+    if v in ("0", "f", "false"):
+        return False
+    return True
+
+
+def layer_params(n: int, p: float) -> tuple[int, int]:
+    """Bloom sizing for ``n`` keys at false-positive rate ``p``:
+    ``m = -n ln p / (ln 2)^2`` bits rounded up to whole uint32 words,
+    ``k = (m/n) ln 2`` probes (clamped to [1, 16]). Pure integer
+    output of a fixed float formula — part of the determinism contract
+    (docs/FILTER_FORMAT.md): identical (n, p) always yields identical
+    (m, k)."""
+    if n <= 0:
+        raise ValueError("layer over an empty key set")
+    m = max(64, math.ceil(-n * math.log(p) / (math.log(2) ** 2)))
+    m = ((m + 31) // 32) * 32
+    k = min(16, max(1, round((m / n) * math.log(2))))
+    return m, k
+
+
+def _probe_np(keys: np.ndarray, m: int, k: int, layer: int) -> np.ndarray:
+    """Probe positions ``int64[n, k]`` in [0, m) for uint32[n, 4] keys.
+    Wrapping-uint32 double hashing; the jnp mirror below must stay
+    arithmetically identical (bit-equal bitmaps are the device/host
+    parity contract)."""
+    keys = np.asarray(keys, np.uint32)
+    # Layer-mix scalars wrapped in Python int space (numpy scalar
+    # uint32 multiply warns on overflow; the array arithmetic below
+    # wraps silently like the jnp mirror).
+    lay_gold = np.uint32((layer * int(_GOLD)) & 0xFFFFFFFF)
+    lay_mix = np.uint32((layer * int(_MIX)) & 0xFFFFFFFF)
+    a = (keys[:, 0] ^ lay_gold) + keys[:, 2]
+    b = ((keys[:, 1] ^ lay_mix) + keys[:, 3]) | np.uint32(1)
+    i = np.arange(k, dtype=np.uint32)
+    pos = a[:, None] + i[None, :] * b[:, None]
+    return (pos % np.uint32(m)).astype(np.int64)
+
+
+_jit_cache: dict = {}
+
+
+def _layer_bits_jit():
+    """Jitted device layer build: probe + bit-scatter in one execution.
+    Scattering plain ``True`` values keeps the duplicate-index write
+    deterministic (every colliding write stores the same value), so
+    the readback equals the host lane's bitmap bit for bit."""
+    fn = _jit_cache.get("bits")
+    if fn is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("m", "k"))
+        def fn(keys, valid, layer, m, k):
+            keys = keys.astype(jnp.uint32)
+            lay = layer.astype(jnp.uint32)
+            a = (keys[:, 0] ^ (lay * jnp.uint32(0x9E3779B9))) + keys[:, 2]
+            b = ((keys[:, 1] ^ (lay * jnp.uint32(0x85EBCA6B)))
+                 + keys[:, 3]) | jnp.uint32(1)
+            i = jnp.arange(k, dtype=jnp.uint32)
+            pos = (a[:, None] + i[None, :] * b[:, None]) % jnp.uint32(m)
+            # Padding lanes park at m and drop out of the scatter.
+            pos = jnp.where(valid[:, None], pos.astype(jnp.int32), m)
+            bits = jnp.zeros((m,), jnp.bool_)
+            return bits.at[pos.reshape(-1)].set(True, mode="drop")
+
+        _jit_cache["bits"] = fn
+    return fn
+
+
+def _pack_words(bits: np.ndarray) -> np.ndarray:
+    """bool[m] (m % 32 == 0) → little-endian uint32[m/32] words; bit
+    ``j`` of the bitmap is word ``j >> 5`` bit ``j & 31``."""
+    return np.packbits(bits, bitorder="little").view("<u4")
+
+
+def build_layer(keys: np.ndarray, m: int, k: int, layer: int,
+                use_device: bool | None = None) -> np.ndarray:
+    """One Bloom layer over ``keys``: uint32[m/32] bitmap words.
+
+    Large layers scatter on device in one jitted execution (key count
+    padded to the next power of two so compile shapes stay log-bounded,
+    like the sharded dispatch); small layers or ``CTMR_FILTER_DEVICE=0``
+    take the identical-by-construction NumPy lane."""
+    n = int(keys.shape[0])
+    if use_device is None:
+        use_device = device_enabled() and n >= DEVICE_BUILD_MIN
+    with trace.span("filter.layer", cat="filter", keys=n, m=m,
+                    device=int(bool(use_device))):
+        if use_device:
+            import jax.numpy as jnp
+
+            width = max(16, 1 << (max(n, 1) - 1).bit_length())
+            padded = np.zeros((width, 4), np.uint32)
+            padded[:n] = keys
+            valid = np.zeros((width,), bool)
+            valid[:n] = True
+            bits = np.asarray(_layer_bits_jit()(
+                jnp.asarray(padded), jnp.asarray(valid),
+                np.uint32(layer), m, k))
+        else:
+            bits = np.zeros((m,), bool)
+            if n:
+                bits[_probe_np(keys, m, k, layer).reshape(-1)] = True
+        return _pack_words(bits)
+
+
+def layer_contains(words: np.ndarray, m: int, k: int, layer: int,
+                   keys: np.ndarray) -> np.ndarray:
+    """bool[n]: all ``k`` probe bits set for each key (vectorized
+    host probe; the build's false-positive chase and every query path
+    share this one implementation)."""
+    n = int(keys.shape[0])
+    if n == 0:
+        return np.zeros((0,), bool)
+    pos = _probe_np(keys, m, k, layer)
+    w = np.asarray(words, np.uint32)
+    bits = (w[pos >> 5] >> (pos & 31).astype(np.uint32)) & 1
+    return bits.all(axis=1)
+
+
+def _unique_rows(keys: np.ndarray) -> np.ndarray:
+    """Sorted-unique uint32[n, 4] rows (deterministic set canon)."""
+    if keys.shape[0] == 0:
+        return keys.reshape(0, 4).astype(np.uint32)
+    return np.unique(np.asarray(keys, np.uint32), axis=0)
+
+
+@dataclass
+class BloomLayer:
+    m: int  # bits
+    k: int  # probes per key
+    words: np.ndarray  # uint32[m / 32]
+
+
+@dataclass
+class FilterCascade:
+    """An exact-membership cascade over one included key set, relative
+    to the excluded universe it was built against."""
+
+    fp_rate: float
+    n_included: int
+    layers: list[BloomLayer] = field(default_factory=list)
+
+    @classmethod
+    def build(cls, included: np.ndarray, excluded: np.ndarray,
+              fp_rate: float, use_device: bool | None = None
+              ) -> "FilterCascade":
+        """Build the cascade. ``included``/``excluded`` are
+        ``uint32[n, 4]`` fingerprint rows; rows present in both sets
+        (a 128-bit fingerprint collision between distinct identities —
+        astronomically unlikely but cheap to guard) are dropped from
+        the excluded side so the alternation converges."""
+        inc = _unique_rows(np.asarray(included).reshape(-1, 4))
+        exc = _unique_rows(np.asarray(excluded).reshape(-1, 4))
+        if inc.shape[0] and exc.shape[0]:
+            tag = lambda a: {bytes(r.tobytes()) for r in a}  # noqa: E731
+            both = tag(inc) & tag(exc)
+            if both:
+                keep = np.array(
+                    [bytes(r.tobytes()) not in both for r in exc], bool)
+                exc = exc[keep]
+        cascade = cls(fp_rate=float(fp_rate), n_included=int(inc.shape[0]))
+        cur_in, cur_out = inc, exc
+        level = 0
+        while cur_in.shape[0]:
+            if level >= MAX_LAYERS:
+                raise RuntimeError(
+                    f"filter cascade did not converge in {MAX_LAYERS} "
+                    "layers (non-disjoint inputs?)")
+            # Layer 0 carries the target rate; deeper layers hold tiny
+            # FP sets where 0.5 (≈1.44 bits/entry) converges fastest —
+            # the crlite sizing convention.
+            p = fp_rate if level == 0 else 0.5
+            m, k = layer_params(int(cur_in.shape[0]), p)
+            words = build_layer(cur_in, m, k, level, use_device=use_device)
+            cascade.layers.append(BloomLayer(m=m, k=k, words=words))
+            if cur_out.shape[0] == 0:
+                break
+            hits = layer_contains(words, m, k, level, cur_out)
+            cur_in, cur_out = cur_out[hits], cur_in
+            level += 1
+        return cascade
+
+    def contains(self, keys: np.ndarray) -> np.ndarray:
+        """bool[n] membership verdicts. Exact for every key of the
+        build's included ∪ excluded sets; probabilistic (≈ layer-0
+        rate, to be table-confirmed) outside them."""
+        keys = np.asarray(keys, np.uint32).reshape(-1, 4)
+        n = keys.shape[0]
+        ans = np.zeros((n,), bool)
+        undecided = np.arange(n)
+        depth = len(self.layers)
+        for level, layer in enumerate(self.layers):
+            if undecided.size == 0:
+                return ans
+            hit = layer_contains(layer.words, layer.m, layer.k, level,
+                                 keys[undecided])
+            ans[undecided[~hit]] = (level % 2) == 1
+            undecided = undecided[hit]
+        ans[undecided] = (depth % 2) == 1
+        return ans
+
+    def total_bits(self) -> int:
+        return sum(layer.m for layer in self.layers)
+
+    def bits_per_entry(self) -> float:
+        return self.total_bits() / max(1, self.n_included)

@@ -209,8 +209,12 @@ class AggregatorSink:
         self._inflight: deque = deque()  # (PendingIngest, der_of)
         # Without a PEM backend the per-entry serial bytes are only
         # needed for the cross-encoding guard; let the aggregator skip
-        # materializing them when it can (count-only fast path).
-        aggregator.want_serials = backend is not None
+        # materializing them when it can (count-only fast path). A
+        # filter capture (round 15) needs the bytes regardless of PEM
+        # backing — never clobber its want_serials.
+        aggregator.want_serials = (
+            backend is not None
+            or getattr(aggregator, "filter_capture", None) is not None)
         self.entries_in = 0
         # Overlapped ingest (overlapWorkers > 0): raw chunks route
         # through a three-stage scheduler — decode pool ‖ ordered

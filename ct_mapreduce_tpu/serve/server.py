@@ -43,6 +43,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+import numpy as np
+
 from ct_mapreduce_tpu.core.types import ExpDate
 from ct_mapreduce_tpu.serve.batcher import (
     DeadlineExceeded,
@@ -82,11 +84,84 @@ def resolve_serve(replicas: int = 0, device: Optional[bool] = None,
     return r, bool(device), max(0, c)
 
 
+def resolve_filter_first(flag=None) -> bool:
+    """Serve-plane filter-first tier: explicit value > the
+    ``CTMR_SERVE_FILTER_FIRST`` env > off."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get("CTMR_SERVE_FILTER_FIRST", "").strip().lower() \
+        in ("1", "t", "true")
+
+
+class FilterTier:
+    """An epoch-tagged filter-cascade snapshot in front of the table
+    tier (round 15): compiled from the aggregator's filter capture,
+    it answers NEGATIVE lookups without touching a table view — exact
+    for every serial the build-time state knew — and forwards
+    positives to the table-confirm tier, which kills the cascade's
+    false positives. Serials first seen AFTER the build answer through
+    the same epoch/staleness surface the replica pool already reports:
+    the tier's epoch is the pool's floor epoch at build time, and
+    consumers read ``staleness_s`` exactly as they do for views."""
+
+    def __init__(self, artifact, issuer_ids: list[str], epoch: int):
+        self.artifact = artifact
+        # Registry snapshot: run-local issuer index → issuerID, as of
+        # the build. Queries for indices past this snapshot (issuers
+        # first seen after the build) must FORWARD to the table, not
+        # answer negative from a filter that predates them.
+        self.issuer_ids = issuer_ids
+        self.epoch = int(epoch)
+        self.created_wall = time.time()
+
+    @classmethod
+    def build(cls, agg, fp_rate: float, epoch: int) -> "FilterTier":
+        from ct_mapreduce_tpu.filter import build_from_aggregator
+
+        art = build_from_aggregator(agg, fp_rate=fp_rate)
+        ids = [agg.registry.issuer_at(i).id()
+               for i in range(len(agg.registry))]
+        return cls(art, ids, epoch)
+
+    def age_s(self) -> float:
+        return max(0.0, time.time() - self.created_wall)
+
+    def negatives(self, items: list) -> np.ndarray:
+        """bool[n]: lanes the cascade answers *excluded* — definitely
+        unknown as of the build. False means forward to the table
+        (cascade-positive, or outside the build's registry snapshot)."""
+        n = len(items)
+        out = np.zeros((n,), bool)
+        by_group: dict = {}
+        for i, (idx, eh, _sb) in enumerate(items):
+            if 0 <= int(idx) < len(self.issuer_ids):
+                key = (self.issuer_ids[int(idx)], int(eh))
+                by_group.setdefault(key, []).append(i)
+            # idx == -1 (registry never saw the issuer): the TABLE is
+            # the authority on honest-false; forward.
+        with trace.span("serve.filter", cat="serve", lanes=n):
+            for (iss, eh), lanes in by_group.items():
+                g = self.artifact.group_for(iss, eh)
+                if g is None:
+                    # No serials for this (issuer, expDate) at build
+                    # time: exact-negative for the build corpus.
+                    out[lanes] = True
+                    continue
+                hit = self.artifact.query_group(
+                    g, [items[i][2] for i in lanes])
+                out[np.asarray(lanes)[~hit]] = True
+        return out
+
+
 class MembershipOracle:
     """Batched "is serial S known for (issuer, expDate)?" over a live
     aggregator: a hot-serial result cache in front of dynamic batching
     in front of a round-robin pool of epoch-pinned device replicas
-    (host-numpy fallback when no device copy can pin)."""
+    (host-numpy fallback when no device copy can pin). With
+    ``filter_first`` (round 15), a filter-cascade tier sits between
+    the cache and the batcher: cascade-negative lanes answer without a
+    table view, cascade-positive lanes fall through for table
+    confirmation."""
 
     def __init__(
         self,
@@ -98,6 +173,8 @@ class MembershipOracle:
         device: Optional[bool] = None,
         replicas: int = 0,
         cache_size: int = 0,
+        filter_first: Optional[bool] = None,
+        filter_fp_rate: float = 0.0,
     ) -> None:
         self._agg = agg
         replicas, device, cache_size = resolve_serve(
@@ -110,6 +187,32 @@ class MembershipOracle:
         self.batcher = MicroBatcher(
             self._run_batch, max_batch=max_batch, max_delay_s=max_delay_s,
             max_queue_lanes=max_queue_lanes)
+        # Filter-first tier (round 15): built lazily on the first
+        # refresh (construction must not fail when the aggregator has
+        # no capture yet — the tier simply stays cold and every lane
+        # takes the table path).
+        from ct_mapreduce_tpu.filter import DEFAULT_FP_RATE
+
+        self.filter_first = resolve_filter_first(filter_first)
+        self.filter_fp_rate = float(filter_fp_rate) or DEFAULT_FP_RATE
+        self.filter_tier: Optional[FilterTier] = None
+        if self.filter_first and getattr(
+                agg, "filter_capture", None) is not None:
+            try:
+                self.refresh_filter()
+            except Exception:
+                pass  # serve must come up; refresh_filter can retry
+
+    def refresh_filter(self, fp_rate: float = 0.0) -> FilterTier:
+        """(Re)build the filter tier from the live aggregator's
+        capture, tagged with the replica pool's current floor epoch.
+        Raises ``ValueError`` when the aggregator has no capture."""
+        tier = FilterTier.build(
+            self._agg, float(fp_rate) or self.filter_fp_rate,
+            self.snapshots.floor_epoch())
+        self.filter_tier = tier
+        incr_counter("serve", "filter_refresh")
+        return tier
 
     def _run_batch(self, items: list) -> list:
         view = self.snapshots.view()
@@ -127,35 +230,59 @@ class MembershipOracle:
         """items: [(issuer_idx, exp_hour, serial_bytes)] →
         [(known, epoch, staleness_s)]. Cache hits answer immediately
         (valid while their epoch >= the pool's floor — equivalent to
-        the round-robin picking the stalest replica); misses batch
-        through the oracle, each sub-batch answered by ONE pinned
-        view."""
-        if self.cache is None:
-            return self.batcher.submit(items, timeout_s=timeout_s)
-        floor = self.snapshots.floor_epoch()
-        now = time.time()
+        the round-robin picking the stalest replica); cache misses
+        consult the filter tier when armed (cascade-negative lanes
+        answer at the tier's epoch, no table view touched); the rest
+        batch through the oracle, each sub-batch answered by ONE
+        pinned view."""
         n = len(items)
         out: list = [None] * n
-        miss: list[int] = []
-        for i, it in enumerate(items):
-            e = self.cache.get(it, floor)
-            if e is None:
-                miss.append(i)
-            else:
-                out[i] = (e.known, e.epoch,
-                          max(0.0, now - e.created_wall))
-        if n - len(miss):
-            incr_counter("serve", "cache_hit", value=float(n - len(miss)))
+        if self.cache is None:
+            miss = list(range(n))
+        else:
+            floor = self.snapshots.floor_epoch()
+            now = time.time()
+            miss = []
+            for i, it in enumerate(items):
+                e = self.cache.get(it, floor)
+                if e is None:
+                    miss.append(i)
+                else:
+                    out[i] = (e.known, e.epoch,
+                              max(0.0, now - e.created_wall))
+            if n - len(miss):
+                incr_counter("serve", "cache_hit",
+                             value=float(n - len(miss)))
+            if not miss:
+                return out
+            incr_counter("serve", "cache_miss", value=float(len(miss)))
+        tier = self.filter_tier if self.filter_first else None
+        if tier is not None and miss:
+            neg = tier.negatives([items[i] for i in miss])
+            age = tier.age_s()
+            fwd = []
+            for j, i in enumerate(miss):
+                if neg[j]:
+                    out[i] = (False, tier.epoch, age)
+                else:
+                    fwd.append(i)
+            if len(miss) - len(fwd):
+                incr_counter("serve", "filter_negative",
+                             value=float(len(miss) - len(fwd)))
+            if fwd:
+                incr_counter("serve", "filter_forward",
+                             value=float(len(fwd)))
+            miss = fwd
         if not miss:
             return out
-        incr_counter("serve", "cache_miss", value=float(len(miss)))
         res = self.batcher.submit([items[i] for i in miss],
                                   timeout_s=timeout_s)
         done = time.time()
         for i, r in zip(miss, res):
             out[i] = r
-            self.cache.put(items[i], known=r[0], epoch=r[1],
-                           created_wall=done - r[2])
+            if self.cache is not None:
+                self.cache.put(items[i], known=r[0], epoch=r[1],
+                               created_wall=done - r[2])
         return out
 
     def resolve_issuer(self, issuer_id: str) -> int:
@@ -180,6 +307,11 @@ class MembershipOracle:
         body.update(self.snapshots.stats())
         if self.cache is not None:
             body.update(self.cache.stats())
+        body["filter_first"] = bool(self.filter_first)
+        if self.filter_tier is not None:
+            body["filter_epoch"] = self.filter_tier.epoch
+            body["filter_staleness_s"] = round(self.filter_tier.age_s(), 6)
+            body["filter_serials"] = self.filter_tier.artifact.n_serials
         return body
 
     def close(self) -> None:
@@ -225,14 +357,17 @@ class QueryServer:
                  max_queue_lanes: int = 1 << 16,
                  max_staleness_s: float = 1.0,
                  device: Optional[bool] = None, replicas: int = 0,
-                 cache_size: int = 0, transport=None) -> None:
+                 cache_size: int = 0, transport=None,
+                 filter_first: Optional[bool] = None,
+                 filter_fp_rate: float = 0.0) -> None:
         self.host = host
         self.port = int(port)
         self.oracle = MembershipOracle(
             agg, max_batch=max_batch, max_delay_s=max_delay_s,
             max_queue_lanes=max_queue_lanes,
             max_staleness_s=max_staleness_s, device=device,
-            replicas=replicas, cache_size=cache_size)
+            replicas=replicas, cache_size=cache_size,
+            filter_first=filter_first, filter_fp_rate=filter_fp_rate)
         self._transport = transport
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -277,6 +412,28 @@ class QueryServer:
         if meta is None:
             return 404, {"error": "unknown issuer", "issuer": issuer_id}
         return 200, meta
+
+    def handle_filter(self, rest: str):
+        """``GET /filter`` → the whole artifact; ``GET
+        /filter/<issuer>/<expDate>`` → a standalone single-group
+        artifact (byte format of docs/FILTER_FORMAT.md either way).
+        404 when the tier is cold or the group is unknown; the body is
+        the binary blob a crlite-style consumer feeds to ``ct-filter
+        query``."""
+        tier = self.oracle.filter_tier
+        if tier is None:
+            return 404, {"error": "filter tier not armed "
+                                  "(emitFilter / refresh_filter)"}
+        if not rest:
+            return 200, tier.artifact.to_bytes()
+        parts = rest.split("/")
+        if len(parts) != 2:
+            return 400, {"error": "use /filter/<issuer>/<expDate>"}
+        blob = tier.artifact.group_bytes(parts[0], parts[1])
+        if blob is None:
+            return 404, {"error": "no filter group",
+                         "issuer": parts[0], "expDate": parts[1]}
+        return 200, blob
 
     def handle_healthz(self) -> tuple[int, dict]:
         from ct_mapreduce_tpu.telemetry.metrics import get_sink
@@ -336,10 +493,14 @@ class QueryServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            def _respond(self, code: int, body: dict) -> None:
-                payload = json.dumps(body).encode()
+            def _respond(self, code: int, body) -> None:
+                if isinstance(body, (bytes, bytearray)):
+                    payload, ctype = bytes(body), "application/octet-stream"
+                else:
+                    payload, ctype = json.dumps(body).encode(), \
+                        "application/json"
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
@@ -376,6 +537,11 @@ class QueryServer:
 
                         self._respond(*server.handle_issuer(
                             unquote(path[len("/issuer/"):])))
+                    elif path == "/filter" or path.startswith("/filter/"):
+                        from urllib.parse import unquote
+
+                        self._respond(*server.handle_filter(
+                            unquote(path[len("/filter"):]).lstrip("/")))
                     elif path == "/getcert":
                         from urllib.parse import parse_qsl
 

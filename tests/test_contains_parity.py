@@ -69,7 +69,15 @@ def _build_bucket(kept, evicted, max_probes):
     return state2
 
 
-@pytest.mark.parametrize("seed", [3, 17, 91])
+# Seeds 17/91 are @slow since round 15 (tier-1 budget banking, ISSUE
+# 10): three seeds of one fuzz sweep walk the same layout/device code
+# paths — seed 3 keeps the tier-1 gate, the redundant re-rolls run in
+# the full (unmarked) suite.
+@pytest.mark.parametrize("seed", [
+    3,
+    pytest.param(17, marks=pytest.mark.slow),
+    pytest.param(91, marks=pytest.mark.slow),
+])
 def test_contains_parity_open_vs_bucket_vs_device(seed):
     max_probes = 32
     kept, evicted, absent = _corpus(seed, 512)

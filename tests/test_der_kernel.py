@@ -338,22 +338,29 @@ def test_long_form_lengths():
 
 
 def test_mutation_fuzz_walker_host_agreement():
-    """Seeded single-byte mutation fuzz over valid certs.
+    """Seeded single-byte mutation fuzz over valid certs, classified
+    through the differential harness (core/divergence.py — ROADMAP
+    5(a)'s standing buckets).
 
     Contract pinned here:
-    - HARD: when both sides parse, every identity-surface field must
-      be byte-identical (serial window, expiry hour, CA flag, SPKI
-      window, issuer Name window, issuer-CN bytes, CRLDP presence and
-      URLs). A mismatch silently corrupts identity keys.
-    - BOUNDED: the walker may ACCEPT some certs the strict host parser
-      rejects, because it skips subtrees outside the identity surface
-      (Name internals, string decoding, nested TLVs in skipped
-      extensions, minutes/seconds) — akin to Go x509's non-fatal
-      tolerance. This leniency is bounded below and may only come from
-      strictness differences OUTSIDE the identity surface; anything
-      touching identity bytes (time digits, extnValue frames) is
-      validated by the walker itself.
+    - HARD: the verdict-mismatch bucket is EMPTY — when both sides
+      parse, every identity-surface field is byte-identical (serial
+      window, expiry hour, CA flag, SPKI window, issuer Name window,
+      issuer-CN bytes, CRLDP presence and URLs). A mismatch silently
+      corrupts identity keys.
+    - BOUNDED: the device-accepts/host-rejects bucket (the walker's
+      leniency — it skips subtrees outside the identity surface, akin
+      to Go x509's non-fatal tolerance) stays below 25% of accepts.
+    - When the native extractor is present, the sidecar-undecidable
+      bucket is EMPTY too (the sidecar's ok bit is pinned bit-equal to
+      the walker's by tests/test_preparsed.py; drift lands here
+      first).
+    - The `parse.device_accept_rate` metric is published and sane (the
+      fuzz must actually exercise the accept path).
     Lanes the walker rejects take the exact host lane by contract."""
+    from ct_mapreduce_tpu.core import divergence
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
     rng = np.random.default_rng(20260730)
     bases = fixture_certs()
     mutants: list[bytes] = []
@@ -367,67 +374,36 @@ def test_mutation_fuzz_walker_host_agreement():
         mutants.append(bytes(base))
         muts.append((bi, pos, x))
 
-    data, length = pack(mutants, pad_to=1024)
-    out = der_kernel.parse_certs(data, length)
-    ok = np.asarray(out.ok)
-    accepted = field_mismatches = host_rejects = 0
-    reject_muts: list[tuple] = []
-    for i, der in enumerate(mutants):
-        if not ok[i]:
-            continue
-        accepted += 1
-        try:
-            ref = hostder.parse_cert(der)
-        except Exception:
-            # Bounded leniency (see docstring).
-            host_rejects += 1
-            reject_muts.append(muts[i])
-            continue
-        cn_bytes = der[int(out.issuer_cn_off[i]):
-                       int(out.issuer_cn_off[i]) + int(out.issuer_cn_len[i])]
-        try:  # mirror the host's utf-8-then-latin-1 decode (der.py)
-            cn_str = cn_bytes.decode("utf-8")
-        except UnicodeDecodeError:
-            cn_str = cn_bytes.decode("latin-1")
-        if bool(out.has_crldp[i]):
-            try:
-                dev_urls = hostder._parse_crldp(
-                    der, int(out.crldp_off[i]))
-            except Exception:
-                dev_urls = ["<unparseable>"]
-        else:
-            dev_urls = []
-        if (int(out.serial_off[i]) != ref.serial_off
-                or int(out.serial_len[i]) != ref.serial_len
-                or int(out.not_after_hour[i]) != ref.not_after_unix_hour
-                or bool(out.is_ca[i]) != ref.is_ca
-                or int(out.spki_off[i]) != ref.spki_off
-                or int(out.spki_len[i]) != ref.spki_len
-                or int(out.issuer_off[i]) != ref.issuer_off
-                or int(out.issuer_len[i]) != ref.issuer_len
-                or cn_str != ref.issuer_cn
-                or bool(out.has_crldp[i])
-                    != bool(ref.crl_distribution_points)
-                or sorted(dev_urls) != sorted(ref.crl_distribution_points)):
-            field_mismatches += 1
-            # Base certs are freshly generated per run, so make any
-            # hit fully reproducible from the failure output alone.
-            print(f"MISMATCH lane {i} mut={muts[i]} "
-                  f"dev=(so={int(out.serial_off[i])} "
-                  f"sl={int(out.serial_len[i])} "
-                  f"nah={int(out.not_after_hour[i])} "
-                  f"ca={bool(out.is_ca[i])} "
-                  f"po={int(out.spki_off[i])} "
-                  f"pl={int(out.spki_len[i])}) "
-                  f"host=(so={ref.serial_off} sl={ref.serial_len} "
-                  f"nah={ref.not_after_unix_hour} ca={ref.is_ca} "
-                  f"po={ref.spki_off} pl={ref.spki_len}) "
-                  f"der={der.hex()}")
-    # Most single-byte mutations in non-structural bytes stay valid:
-    # the fuzz must actually exercise the accept path. Threshold
-    # failures print the mutation tuples so a bad run is reproducible
-    # (base certs are freshly generated, so counts vary slightly).
-    assert accepted > 50, (accepted, reject_muts[:20])
-    assert field_mismatches == 0, f"{field_mismatches}/{accepted}"
-    assert host_rejects < 0.25 * accepted, (
-        host_rejects, accepted, reject_muts[:20])
+    sink = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink)
+    try:
+        report = divergence.classify_corpus(mutants)
+        divergence.publish(report)
+        snap = sink.snapshot()
+    finally:
+        tmetrics.set_sink(prev)
+
+    # Base certs are freshly generated per run; the report's detail
+    # lines carry the full repro (mutation tuples below cover the
+    # threshold assertions).
+    for line in report.details:
+        print(line)
+    accepted = report.device_accepts
+    assert accepted > 50, (accepted, muts[:20])
+    assert report.verdict_mismatch == 0, report.details
+    assert report.device_accept_host_reject < 0.25 * accepted, (
+        report.device_accept_host_reject, accepted, muts[:20])
+    from ct_mapreduce_tpu.native import available
+
+    if available():
+        assert report.sidecar_undecidable == 0, report.sidecar_undecidable
+    # Bucket bookkeeping is internally consistent.
+    assert (report.both_accept + report.device_accept_host_reject
+            == accepted)
+    assert (report.both_accept + report.host_accept_device_reject
+            == report.host_accepts)
+    # The tracked metric really published.
+    rate = snap["gauges"]["parse.device_accept_rate"]
+    assert 0 < rate <= 1 and rate == accepted / report.total
+    assert snap["counters"]["parse.divergence_verdict_mismatch"] == 0.0

@@ -1,0 +1,128 @@
+"""Filter-compilation cost curves: build rate, bits/entry, and layer
+count vs the target false-positive rate (round 15).
+
+Sweeps a synthetic aggregation state (G (issuer, expDate) groups of N
+serials each, a disjoint probe corpus for the measured-FP column)
+through :func:`ct_mapreduce_tpu.filter.artifact.build_artifact` at a
+range of target rates and prints one JSON line per point:
+
+    python tools/filtercost.py --serials 20000 --groups 8 \\
+        --rates 0.5,0.1,0.01,0.001 --probes 20000
+
+Columns: build wall + serials/s, artifact bytes, bits/entry (the
+compactness headline — crlite's whole point), max cascade depth, the
+MEASURED false-positive rate over the disjoint probes (compare to the
+target; included serials are exact by construction and verified here
+too), and per-query probe cost through the cascade.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def synth_state(n_serials: int, n_groups: int, seed: int = 7,
+                serial_bytes: int = 16):
+    """{(issuerID, expHour): serial list} + a disjoint probe list.
+    Serials are distinct random byte strings; probes never collide
+    with them (distinct length ⇒ distinct fingerprint messages)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    per = max(1, n_serials // n_groups)
+    state = {}
+    for g in range(n_groups):
+        key = (f"synth-issuer-{g % max(1, n_groups // 2)}", 500_000 + g)
+        serials = [rng.integers(0, 256, serial_bytes,
+                                dtype=np.uint8).tobytes()
+                   for _ in range(per)]
+        state[key] = serials
+    return state
+
+
+def synth_probes(n: int, seed: int = 11, serial_bytes: int = 17):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, serial_bytes, dtype=np.uint8).tobytes()
+            for _ in range(n)]
+
+
+def run_point(state: dict, probes: list, rate: float) -> dict:
+    import numpy as np
+
+    from ct_mapreduce_tpu.filter import build_artifact
+
+    n = sum(len(v) for v in state.values())
+    t0 = time.perf_counter()
+    art = build_artifact(state, fp_rate=rate)
+    build_s = time.perf_counter() - t0
+    blob = art.to_bytes()
+
+    # Zero-FN verification over the full included set.
+    fn = 0
+    for (iss, eh), serials in state.items():
+        g = art.group_for(iss, eh)
+        fn += int((~art.query_group(g, serials)).sum())
+
+    # Measured FP over the disjoint probe corpus, spread across groups.
+    fp = probed = 0
+    t0 = time.perf_counter()
+    for (iss, eh), _ in state.items():
+        g = art.group_for(iss, eh)
+        hits = art.query_group(g, probes)
+        fp += int(np.asarray(hits).sum())
+        probed += len(probes)
+    probe_s = time.perf_counter() - t0
+
+    return {
+        "metric": "ct_filter_cost",
+        "fp_rate_target": rate,
+        "serials": n,
+        "groups": len(art.groups),
+        "build_s": round(build_s, 4),
+        "serials_per_s": round(n / max(build_s, 1e-9), 1),
+        "artifact_bytes": len(blob),
+        "bits_per_entry": round(art.bits_per_entry(), 3),
+        "max_layers": art.max_layers(),
+        "false_negatives": fn,
+        "probes": probed,
+        "fp_measured": round(fp / max(1, probed), 6),
+        "probe_ns": round(1e9 * probe_s / max(1, probed), 1),
+    }
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--serials", type=int, default=20000)
+    ap.add_argument("--groups", type=int, default=8)
+    ap.add_argument("--probes", type=int, default=20000)
+    ap.add_argument("--rates", default="0.5,0.1,0.01,0.001")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+
+    state = synth_state(args.serials, args.groups, seed=args.seed)
+    probes = synth_probes(args.probes, seed=args.seed + 4)
+    rc = 0
+    for rate in (float(r) for r in args.rates.split(",") if r):
+        point = run_point(state, probes, rate)
+        print(json.dumps(point))
+        if point["false_negatives"]:
+            print(f"FALSE NEGATIVES at rate {rate}: "
+                  f"{point['false_negatives']}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

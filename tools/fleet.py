@@ -164,6 +164,19 @@ def merged_snapshot(state_paths: list[str]) -> dict:
     return snapshot_jsonable(merge.load_checkpoints(state_paths).drain())
 
 
+def filter_bytes(state_paths: list[str], fp_rate: float = 0.01) -> bytes:
+    """The filter artifact compiled from one or many checkpoints —
+    the byte-comparable parity object of the round-15 determinism
+    contract: a W-worker fleet's merged filter must equal the serial
+    run's bit for bit (canonical keys hash under sorted-issuerID
+    ordinals, so worker-local registry numbering cancels out)."""
+    from ct_mapreduce_tpu.agg import merge
+    from ct_mapreduce_tpu.filter import build_from_merged
+
+    merged = merge.load_checkpoints(state_paths)
+    return build_from_merged(merged, fp_rate=fp_rate).to_bytes()
+
+
 def _enable_compile_cache() -> None:
     """CT_COMPILE_CACHE for worker processes (same contract as
     bench.maybe_enable_compile_cache): the W children compile the same
@@ -197,7 +210,7 @@ def write_worker_ini(path: str, fixture: dict, state_path: str,
                      redis_addr: str = "", worker_id: int = 0,
                      num_workers: int = 1, checkpoint_period: str = "",
                      batch_size: int = 64, table_bits: int = 12,
-                     coordinator: str = "") -> None:
+                     coordinator: str = "", emit_filter: bool = True) -> None:
     lines = [
         f"logList = {','.join(fixture['logs'])}",
         "backend = tpu",
@@ -209,6 +222,11 @@ def write_worker_ini(path: str, fixture: dict, state_path: str,
         "nobars = true",
         "savePeriod = 15m",
     ]
+    if emit_filter:
+        # Filter capture in every harness checkpoint (round 15): the
+        # --verify path builds the merged fleet filter from the worker
+        # snapshots and byte-compares it against the serial run's.
+        lines += ["emitFilter = true", "filterFpRate = 0.01"]
     if redis_addr:
         lines.append(f"redisHost = {redis_addr}")
     if num_workers > 1 or coordinator:
@@ -435,6 +453,15 @@ def run_fleet(workers: int = 2, n_logs: int = 4, entries_per_log: int = 256,
         if merged != ref:
             result["merged"] = merged
             result["reference"] = ref
+        # Round-15 artifact determinism: merged fleet filter ==
+        # serial-run filter, byte for byte.
+        fleet_blob = filter_bytes(state_paths)
+        serial_blob = filter_bytes(
+            [os.path.join(state_dir, "serial.npz")])
+        result["filter_parity"] = int(fleet_blob == serial_blob)
+        result["filter_bytes"] = len(fleet_blob)
+        if fleet_blob != serial_blob:
+            result["filter_bytes_serial"] = len(serial_blob)
     return result
 
 
@@ -478,6 +505,9 @@ def main(argv=None) -> int:
     print(json.dumps(out, indent=2))
     if args.verify and not out.get("parity"):
         print("PARITY MISMATCH", file=sys.stderr)
+        return 1
+    if args.verify and not out.get("filter_parity"):
+        print("FILTER ARTIFACT MISMATCH", file=sys.stderr)
         return 1
     return 0
 
