@@ -1755,11 +1755,15 @@ class TpuAggregator:
         """
         with self._save_lock:
             self.complete_outstanding()
-            host_items = [
+            # Sorted like the filter capture below: host_keys/host_vals
+            # land in the .npz in iteration order, and dict insertion
+            # order differs between a fleet merge and a serial run even
+            # when the contents are equal (ctmrlint: determinism).
+            host_items = sorted(
                 (idx, eh, b";".join(s.hex().encode()
                                     for s in sorted(serials)))
                 for (idx, eh), serials in self.host_serials.items()
-            ]
+            )
             directory = os.path.dirname(os.path.abspath(path))
             fd, tmp_path = tempfile.mkstemp(
                 prefix=os.path.basename(path) + ".tmp.", dir=directory
@@ -1854,15 +1858,20 @@ class TpuAggregator:
                 [(i, e) for i, e, _ in host_items], dtype=np.int64
             ).reshape(-1, 2),
             host_vals=np.array([v for _, _, v in host_items], dtype=object),
+            # json.dumps preserves dict insertion order, so the key
+            # iteration must be sorted too or the serialized bytes
+            # depend on fold arrival order (ctmrlint: determinism).
             crl_sets=np.frombuffer(
                 json.dumps(
-                    {str(k): sorted(v) for k, v in self.crl_sets.items()}
+                    {str(k): sorted(v)
+                     for k, v in sorted(self.crl_sets.items())}
                 ).encode(),
                 dtype=np.uint8,
             ),
             dn_sets=np.frombuffer(
                 json.dumps(
-                    {str(k): sorted(v) for k, v in self.dn_sets.items()}
+                    {str(k): sorted(v)
+                     for k, v in sorted(self.dn_sets.items())}
                 ).encode(),
                 dtype=np.uint8,
             ),

@@ -58,16 +58,20 @@ def merge_snapshots(snaps) -> AggregateSnapshot:
     dns: dict[str, set[str]] = {}
     verified: dict[str, int] = {}
     failed: dict[str, int] = {}
+    # Sorted folds: the merged dicts' insertion order (which reaches
+    # serialized reports/checkpoints downstream) must be a function of
+    # the CONTENT, not of each worker's fold arrival order
+    # (ctmrlint: determinism).
     for snap in snaps:
-        for key, n in snap.counts.items():
+        for key, n in sorted(snap.counts.items()):
             counts[key] = counts.get(key, 0) + n
-        for iss, urls in snap.crls.items():
+        for iss, urls in sorted(snap.crls.items()):
             crls.setdefault(iss, set()).update(urls)
-        for iss, names in snap.dns.items():
+        for iss, names in sorted(snap.dns.items()):
             dns.setdefault(iss, set()).update(names)
-        for iss, n in snap.verified.items():
+        for iss, n in sorted(snap.verified.items()):
             verified[iss] = verified.get(iss, 0) + n
-        for iss, n in snap.failed.items():
+        for iss, n in sorted(snap.failed.items()):
             failed[iss] = failed.get(iss, 0) + n
     return AggregateSnapshot(
         counts=counts, crls=crls, dns=dns, total=sum(counts.values()),
@@ -107,13 +111,15 @@ class MergedAggregate:
             idx: self.registry.assign_issuer(agg.registry.issuer_at(idx))
             for idx in range(len(agg.registry))
         }
-        for (idx, eh), serials in agg.host_serials.items():
+        # Sorted for the same reason as merge_snapshots: merged-dict
+        # insertion order must not encode worker fold order.
+        for (idx, eh), serials in sorted(agg.host_serials.items()):
             key = (remap[idx], eh)
             self.host_serials.setdefault(key, set()).update(serials)
         if agg.filter_capture is None:
             self.capture_missing.append(path)
         else:
-            for (idx, eh), serials in agg.filter_capture.items():
+            for (idx, eh), serials in sorted(agg.filter_capture.items()):
                 key = (remap[idx], eh)
                 self.filter_serials.setdefault(key, set()).update(serials)
 

@@ -1,0 +1,46 @@
+"""Project-invariant static analysis (`ctmrlint`) + runtime lock-order
+witness.
+
+The package's correctness contracts — the lock hierarchy (fold →
+table, dispatch serializes the donated device stream), donation
+discipline (a buffer passed to a ``*_donated`` entry point is dead),
+byte-determinism of filter/checkpoint serialization, and jit-body
+purity — lived in comments until round 16. This subpackage turns them
+into machine-checked gates:
+
+- :mod:`.engine` — pluggable AST checker framework: one walk of the
+  package per run, checkers subscribe to node events; baseline file
+  for justified exceptions.
+- :mod:`.lockspec` — the DECLARED lock hierarchy (every lock in the
+  package, with a rank in the partial order) shared by the static
+  lock-order rule and the runtime witness.
+- :mod:`.lock_order`, :mod:`.donation`, :mod:`.determinism`,
+  :mod:`.jit_purity`, :mod:`.metric_registry`, :mod:`.config_parity`
+  — the project-specific rules.
+- :mod:`.witness` — instrumented lock wrapper (opt-in via
+  ``CTMR_LOCK_WITNESS=1``) recording per-thread acquisition chains
+  into a global edge graph; detects order violations and cycles live
+  and dumps findings through the flight recorder.
+- :mod:`.cli` — the ``ctmrlint`` console script (text/JSON, exit
+  codes 0/1/2).
+
+Nothing here imports jax (or any device code): the lint lane must run
+in CI in seconds, and the witness must be installable before the
+heavyweight imports it observes.
+"""
+
+from ct_mapreduce_tpu.analysis.engine import (  # noqa: F401
+    AnalysisEngine,
+    Checker,
+    Finding,
+    load_baseline,
+    run_analysis,
+)
+
+__all__ = [
+    "AnalysisEngine",
+    "Checker",
+    "Finding",
+    "load_baseline",
+    "run_analysis",
+]

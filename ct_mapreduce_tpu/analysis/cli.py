@@ -1,0 +1,120 @@
+"""``ctmrlint`` — the project-invariant linter CLI.
+
+Exit codes (scripting contract, pinned by tests/test_lint.py):
+  0  clean (no non-baselined findings)
+  1  violations found
+  2  internal error / bad invocation
+
+Never imports jax: an AST-only pass over the package in seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from ct_mapreduce_tpu.analysis import engine as _engine
+
+DEFAULT_BASELINE = "ctmrlint.baseline"
+
+
+def find_default_baseline(root: pathlib.Path):
+    """``ctmrlint.baseline`` next to the scanned package (repo root)."""
+    candidate = root.resolve().parent / DEFAULT_BASELINE
+    return candidate if candidate.exists() else None
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ctmrlint",
+        description="ct-mapreduce-tpu project-invariant static analysis")
+    p.add_argument("root", nargs="?", default="ct_mapreduce_tpu",
+                   help="package directory to analyze "
+                        "(default: ct_mapreduce_tpu)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file of justified exceptions "
+                        f"(default: <root>/../{DEFAULT_BASELINE} when "
+                        f"present; 'none' disables)")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule names to run "
+                        "(default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule set and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as err:  # argparse exits 2 on bad usage already
+        return int(err.code or 0)
+    try:
+        checkers = _engine.default_checkers()
+        if args.list_rules:
+            for c in checkers:
+                print(c.name)
+            return 0
+        if args.rules:
+            wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+            unknown = wanted - {c.name for c in checkers}
+            if unknown:
+                print(f"ctmrlint: unknown rule(s): "
+                      f"{', '.join(sorted(unknown))}", file=sys.stderr)
+                return 2
+            checkers = [c for c in checkers if c.name in wanted]
+        root = pathlib.Path(args.root)
+        if not root.is_dir():
+            print(f"ctmrlint: not a directory: {root}", file=sys.stderr)
+            return 2
+        if args.baseline == "none":
+            baseline_path = None
+        elif args.baseline:
+            baseline_path = pathlib.Path(args.baseline)
+            if not baseline_path.exists():
+                print(f"ctmrlint: baseline not found: {baseline_path}",
+                      file=sys.stderr)
+                return 2
+        else:
+            baseline_path = find_default_baseline(root)
+        live, suppressed, unused = _engine.run_analysis(
+            root, checkers=checkers, baseline_path=baseline_path)
+        # A baseline entry for a rule that did not run this invocation
+        # is not stale — it just wasn't exercised (--rules filtering).
+        ran = {c.name for c in checkers}
+        unused = [k for k in unused if k.split(":", 1)[0] in ran]
+    except Exception as err:  # the tool must never die silently
+        print(f"ctmrlint: error: {type(err).__name__}: {err}",
+              file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in live],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "unused_baseline": unused,
+            "counts": {
+                "findings": len(live),
+                "suppressed": len(suppressed),
+                "unused_baseline": len(unused),
+            },
+        }, indent=2))
+    else:
+        for f in live:
+            print(f.render())
+        if suppressed:
+            print(f"ctmrlint: {len(suppressed)} baselined finding(s) "
+                  f"suppressed")
+        for k in unused:
+            print(f"ctmrlint: warning: stale baseline entry (matched "
+                  f"nothing): {k}")
+        if not live:
+            print("ctmrlint: clean")
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

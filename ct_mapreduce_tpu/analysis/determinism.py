@@ -1,0 +1,163 @@
+"""determinism: serialized bytes may not depend on wall-clock, RNG, or
+hash order.
+
+The fleet-vs-serial byte-identity contract (docs/FILTER_FORMAT.md, the
+round-15 merged-filter gate, checkpoint byte-parity when features are
+off) holds only because every serialization path iterates in sorted
+order and never reads a clock. This rule pins that: inside the scoped
+modules/functions it flags
+
+- wall-clock reads (``time.time``/``monotonic``/``strftime``,
+  ``datetime.now``/``utcnow``),
+- randomness (``random.*``, ``np.random.*``, ``os.urandom``,
+  ``uuid.*``),
+- iteration over ``.keys()``/``.values()``/``.items()`` or ``set()``
+  results that is not wrapped in ``sorted(...)`` — dict/set order is
+  insertion/hash order, which differs between a fleet merge and a
+  serial run even when the contents are equal.
+
+Scope is declared data (:data:`SCOPE_MODULES`,
+:data:`SCOPE_FUNCTIONS`): the rule is for byte-producing paths, not a
+style ban on clocks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ct_mapreduce_tpu.analysis.engine import Checker, Ctx
+
+# Whole modules whose job is producing deterministic bytes.
+SCOPE_MODULES: tuple[str, ...] = (
+    "ct_mapreduce_tpu/filter/artifact.py",
+    "ct_mapreduce_tpu/filter/cascade.py",
+    "ct_mapreduce_tpu/agg/merge.py",
+)
+
+# (module pattern, function name): serialization paths inside
+# otherwise-unscoped modules.
+SCOPE_FUNCTIONS: tuple[tuple[str, str], ...] = (
+    ("ct_mapreduce_tpu/agg/aggregator.py", "save_checkpoint"),
+    ("ct_mapreduce_tpu/agg/aggregator.py", "_write_npz"),
+)
+
+_WALL_CLOCK = {
+    ("time", "time"), ("time", "time_ns"), ("time", "monotonic"),
+    ("time", "monotonic_ns"), ("time", "strftime"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("date", "today"),
+}
+_RANDOM_ROOTS = {"random", "uuid"}
+
+
+def _attr_chain(expr: ast.AST) -> list[str]:
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+    parts.reverse()
+    return parts
+
+
+class DeterminismChecker(Checker):
+    name = "determinism"
+
+    def _scoped(self, ctx: Ctx) -> bool:
+        relpath = ctx.module.relpath
+        if any(ctx.module.matches(p) for p in SCOPE_MODULES):
+            return True
+        import fnmatch
+        for fn in ctx.func_stack:
+            fname = getattr(fn, "name", None)
+            if fname is None:
+                continue
+            for pat, scoped_fn in SCOPE_FUNCTIONS:
+                if fname == scoped_fn and fnmatch.fnmatch(relpath, pat):
+                    return True
+        return False
+
+    def _func_label(self, ctx: Ctx) -> str:
+        for fn in reversed(ctx.func_stack):
+            name = getattr(fn, "name", None)
+            if name is not None:
+                return name
+        return "module"
+
+    def visit_Call(self, node: ast.Call, ctx: Ctx) -> None:
+        if not self._scoped(ctx):
+            return
+        chain = _attr_chain(node.func)
+        if len(chain) < 2:
+            return
+        root, leaf = chain[0], chain[-1]
+        pair = (chain[-2], leaf)
+        relpath = ctx.module.relpath
+        label = self._func_label(ctx)
+        if pair in _WALL_CLOCK:
+            self.report(
+                relpath, node.lineno,
+                f"{label}:clock:{'.'.join(chain)}",
+                f"wall-clock read {'.'.join(chain)}() in a "
+                f"serialization path — bytes must not depend on when "
+                f"they were produced")
+        elif root in _RANDOM_ROOTS or (
+                root in ("np", "numpy") and "random" in chain):
+            self.report(
+                relpath, node.lineno,
+                f"{label}:random:{'.'.join(chain)}",
+                f"randomness {'.'.join(chain)}() in a serialization "
+                f"path — bytes must be a pure function of the inputs")
+        elif (chain[-2], leaf) == ("os", "urandom"):
+            self.report(
+                relpath, node.lineno, f"{label}:random:os.urandom",
+                "os.urandom in a serialization path")
+
+    # Wrapping calls for which iteration order cannot reach the output
+    # bytes: full sorts and commutative/associative reductions.
+    _ORDER_FREE_WRAPPERS = {"sorted", "sum", "min", "max", "any", "all",
+                            "len", "set", "frozenset"}
+
+    def _order_free_context(self, node: ast.AST, ctx: Ctx) -> bool:
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Call) and isinstance(
+                parent.func, ast.Name):
+            return parent.func.id in self._ORDER_FREE_WRAPPERS
+        return False
+
+    def _check_iter(self, iter_node: ast.AST, lineno: int,
+                    ctx: Ctx) -> None:
+        """Flag unsorted dict-view/set iteration feeding the loop."""
+        bad: Optional[str] = None
+        if isinstance(iter_node, ast.Call):
+            fn = iter_node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in (
+                    "keys", "values", "items"):
+                bad = f".{fn.attr}()"
+            elif isinstance(fn, ast.Name) and fn.id in ("set", "frozenset"):
+                bad = f"{fn.id}()"
+        elif isinstance(iter_node, ast.Set):
+            bad = "set literal"
+        if bad is None:
+            return
+        self.report(
+            ctx.module.relpath, lineno,
+            f"{self._func_label(ctx)}:unsorted:{bad}",
+            f"iterating {bad} without sorted(...) in a serialization "
+            f"path — hash/insertion order is not deterministic across "
+            f"fleet merge vs serial runs")
+
+    def visit_For(self, node: ast.For, ctx: Ctx) -> None:
+        if self._scoped(ctx):
+            self._check_iter(node.iter, node.lineno, ctx)
+
+    def _comp(self, node, ctx: Ctx) -> None:
+        if self._scoped(ctx) and not self._order_free_context(node, ctx):
+            for gen in node.generators:
+                self._check_iter(gen.iter, node.lineno, ctx)
+
+    visit_ListComp = _comp
+    visit_SetComp = _comp
+    visit_GeneratorExp = _comp
+    visit_DictComp = _comp

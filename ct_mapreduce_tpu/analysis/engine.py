@@ -1,0 +1,296 @@
+"""Pluggable AST checker framework.
+
+One parse + one walk of every package module per run, no matter how
+many rules are active: the engine maintains the traversal context
+(enclosing class/function/``with`` stacks, parent links) and
+dispatches node events to whichever checkers subscribed to them via
+``visit_<NodeType>`` methods — the same shape ``ast.NodeVisitor``
+has, minus the per-checker walk.
+
+Intentionally jax-free: the lint gate parses source, it never imports
+the modules it checks, so it runs in seconds with no device/XLA
+startup and can gate CI before anything heavyweight builds.
+
+Baselines: a finding's :meth:`Finding.key` is stable across line-number
+drift (``rule:path:symbol``); the baseline file maps keys to one-line
+justifications so known, justified exceptions don't fail the gate —
+and unused entries are surfaced so the file can only shrink.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import pathlib
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+
+@dataclass
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str  # rule name, e.g. "lock-order"
+    path: str  # repo-relative posix path
+    line: int  # 1-based; 0 for whole-file/project findings
+    symbol: str  # stable key component (lock name, metric key, ...)
+    message: str
+
+    def key(self) -> str:
+        """Baseline key: stable across line-number drift."""
+        return f"{self.rule}:{self.path}:{self.symbol}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: {self.rule}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "key": self.key(),
+        }
+
+
+@dataclass
+class Module:
+    """Per-file context handed to checkers."""
+
+    path: pathlib.Path  # absolute
+    relpath: str  # repo-relative posix
+    tree: ast.AST
+    source: str
+
+    def matches(self, pattern: str) -> bool:
+        return fnmatch.fnmatch(self.relpath, pattern)
+
+
+@dataclass
+class Project:
+    """Cross-module context for ``finish()``-time checks."""
+
+    root: pathlib.Path  # the scanned package directory
+    repo_root: pathlib.Path  # its parent (docs/, MIGRATING.md live here)
+    modules: list[Module] = field(default_factory=list)
+
+    def module(self, relpath: str) -> Optional[Module]:
+        for m in self.modules:
+            if m.relpath == relpath:
+                return m
+        return None
+
+
+class Ctx:
+    """Traversal context: where the engine currently is."""
+
+    def __init__(self, module: Module) -> None:
+        self.module = module
+        self.class_stack: list[ast.ClassDef] = []
+        self.func_stack: list[ast.AST] = []  # FunctionDef | AsyncFunctionDef | Lambda
+        self.with_stack: list[ast.With] = []
+        self._parents: dict[int, ast.AST] = {}
+
+    @property
+    def cls(self) -> Optional[str]:
+        return self.class_stack[-1].name if self.class_stack else None
+
+    @property
+    def func(self) -> Optional[ast.AST]:
+        return self.func_stack[-1] if self.func_stack else None
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+
+class Checker:
+    """Base class: subclasses define ``name`` and any subset of
+    ``visit_<NodeType>(node, ctx)`` / ``begin_module(ctx)`` /
+    ``end_module(ctx)`` / ``finish(project)`` and report via
+    :meth:`report`."""
+
+    name = "checker"
+
+    def __init__(self) -> None:
+        self.findings: list[Finding] = []
+
+    def report(self, relpath: str, line: int, symbol: str,
+               message: str) -> None:
+        self.findings.append(
+            Finding(self.name, relpath, line, symbol, message))
+
+    # Optional hooks (engine calls them when present):
+    # begin_module(ctx) / end_module(ctx) / visit_<Type>(node, ctx)
+    def finish(self, project: Project) -> None:  # pragma: no cover
+        pass
+
+
+_SCOPE_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class AnalysisEngine:
+    """Walks the package once, dispatching node events to checkers."""
+
+    def __init__(self, checkers: Iterable[Checker]) -> None:
+        self.checkers = list(checkers)
+        # node-type name -> [(checker, bound method)], built lazily so
+        # only types someone subscribed to pay dispatch cost.
+        self._dispatch: dict[str, list[Callable]] = {}
+        for c in self.checkers:
+            for attr in dir(c):
+                if attr.startswith("visit_"):
+                    self._dispatch.setdefault(
+                        attr[len("visit_"):], []).append(getattr(c, attr))
+        self.errors: list[Finding] = []
+
+    # -- file set --------------------------------------------------------
+    @staticmethod
+    def package_files(root: pathlib.Path) -> list[pathlib.Path]:
+        return sorted(
+            p for p in root.rglob("*.py") if "__pycache__" not in p.parts
+        )
+
+    def run(self, root: pathlib.Path) -> list[Finding]:
+        """Parse + walk every module under ``root``; returns all
+        findings (parse failures surface as rule ``parse-error``)."""
+        root = root.resolve()
+        repo_root = root.parent
+        project = Project(root=root, repo_root=repo_root)
+        for path in self.package_files(root):
+            relpath = path.relative_to(repo_root).as_posix()
+            try:
+                source = path.read_text()
+                tree = ast.parse(source, filename=str(path))
+            except (SyntaxError, UnicodeDecodeError, OSError) as err:
+                self.errors.append(Finding(
+                    "parse-error", relpath, getattr(err, "lineno", 0) or 0,
+                    "parse", f"cannot analyze: {err}"))
+                continue
+            module = Module(path=path, relpath=relpath, tree=tree,
+                            source=source)
+            project.modules.append(module)
+            self._walk_module(module)
+        for c in self.checkers:
+            c.finish(project)
+        out: list[Finding] = list(self.errors)
+        for c in self.checkers:
+            out.extend(c.findings)
+        out.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))
+        return out
+
+    # -- traversal -------------------------------------------------------
+    def _walk_module(self, module: Module) -> None:
+        ctx = Ctx(module)
+        for c in self.checkers:
+            begin = getattr(c, "begin_module", None)
+            if begin is not None:
+                begin(ctx)
+        self._visit(module.tree, ctx)
+        for c in self.checkers:
+            end = getattr(c, "end_module", None)
+            if end is not None:
+                end(ctx)
+
+    def _visit(self, node: ast.AST, ctx: Ctx) -> None:
+        handlers = self._dispatch.get(type(node).__name__)
+        if handlers is not None:
+            for h in handlers:
+                h(node, ctx)
+        is_class = isinstance(node, ast.ClassDef)
+        is_scope = isinstance(node, _SCOPE_TYPES)
+        is_with = isinstance(node, ast.With)
+        if is_class:
+            ctx.class_stack.append(node)
+        if is_scope:
+            ctx.func_stack.append(node)
+        if is_with:
+            ctx.with_stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            ctx._parents[id(child)] = node
+            self._visit(child, ctx)
+        if is_with:
+            ctx.with_stack.pop()
+        if is_scope:
+            ctx.func_stack.pop()
+        if is_class:
+            ctx.class_stack.pop()
+
+
+# -- baseline ------------------------------------------------------------
+
+def load_baseline(path) -> dict[str, str]:
+    """``key | justification`` per line; ``#`` comments and blanks
+    ignored. A key without a justification is invalid (the whole point
+    is forcing the why next to the exception) and raises ValueError."""
+    entries: dict[str, str] = {}
+    text = pathlib.Path(path).read_text()
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, sep, why = line.partition("|")
+        key, why = key.strip(), why.strip()
+        if not sep or not why:
+            raise ValueError(
+                f"{path}:{lineno}: baseline entry needs "
+                f"'key | justification', got: {raw!r}")
+        entries[key] = why
+    return entries
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[str, str]
+) -> tuple[list[Finding], list[Finding], list[str]]:
+    """Split findings into (live, suppressed) and list baseline keys
+    that matched nothing (stale entries — the file must only shrink)."""
+    live: list[Finding] = []
+    suppressed: list[Finding] = []
+    used: set[str] = set()
+    for f in findings:
+        k = f.key()
+        if k in baseline:
+            used.add(k)
+            suppressed.append(f)
+        else:
+            live.append(f)
+    unused = [k for k in baseline if k not in used]
+    return live, suppressed, unused
+
+
+def default_checkers() -> list[Checker]:
+    """The full project rule set (import here, not at module top, so
+    ``engine`` stays dependency-free for checker unit tests)."""
+    from ct_mapreduce_tpu.analysis.config_parity import ConfigParityChecker
+    from ct_mapreduce_tpu.analysis.determinism import DeterminismChecker
+    from ct_mapreduce_tpu.analysis.donation import DonationChecker
+    from ct_mapreduce_tpu.analysis.jit_purity import JitPurityChecker
+    from ct_mapreduce_tpu.analysis.lock_order import LockOrderChecker
+    from ct_mapreduce_tpu.analysis.metric_registry import (
+        MetricRegistryChecker,
+    )
+
+    return [
+        LockOrderChecker(),
+        DonationChecker(),
+        DeterminismChecker(),
+        JitPurityChecker(),
+        MetricRegistryChecker(),
+        ConfigParityChecker(),
+    ]
+
+
+def run_analysis(
+    root,
+    checkers: Optional[Iterable[Checker]] = None,
+    baseline_path=None,
+) -> tuple[list[Finding], list[Finding], list[str]]:
+    """Convenience wrapper: run the engine over ``root`` and apply the
+    baseline. Returns (live findings, suppressed findings, unused
+    baseline keys)."""
+    engine = AnalysisEngine(
+        default_checkers() if checkers is None else checkers)
+    findings = engine.run(pathlib.Path(root))
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    return apply_baseline(findings, baseline)

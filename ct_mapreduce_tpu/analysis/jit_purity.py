@@ -1,0 +1,151 @@
+"""jit-purity: no host side effects inside traced bodies.
+
+A ``jax.jit``-ed function (or a ``fori_loop``/``scan``/``while_loop``
+carrier) runs its Python body ONCE, at trace time, per compile-cache
+shape. A metric emit, span, print, or lock acquisition inside one is
+wrong twice over: it fires on compiles rather than executions (so the
+telemetry lies), and under the persistent compile cache it may never
+fire at all. Lock use at trace time is worse — the traced body can be
+re-entered under different callers' locks, deadlocking on compile.
+
+Detection is name-based and module-local: functions decorated with
+``jit``/``jax.jit``/``partial(jax.jit, ...)``, functions wrapped via
+``X = jax.jit(f)`` / ``functools.partial(jax.jit, ...)(f)``, and
+local defs passed by name (or lambdas passed inline) to
+``fori_loop``/``scan``/``while_loop``/``cond``/``switch``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ct_mapreduce_tpu.analysis.engine import Checker, Ctx
+
+_LOOP_FUNCS = {"fori_loop", "scan", "while_loop", "cond", "switch"}
+_METRIC_FUNCS = {"incr_counter", "set_gauge", "add_sample", "measure"}
+_IMPURE_CALL_TAILS = {
+    "print": "print at trace time (fires per compile, not per step)",
+    "span": "span at trace time (telemetry would count compiles)",
+}
+_IMPURE_CHAINS = {
+    ("time", "time"): "wall-clock at trace time",
+    ("time", "monotonic"): "wall-clock at trace time",
+    ("datetime", "now"): "wall-clock at trace time",
+}
+
+
+def _attr_chain(expr: ast.AST) -> list[str]:
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+    parts.reverse()
+    return parts
+
+
+def _is_jit_expr(expr: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` / ``partial(jax.jit, ...)`` /
+    ``functools.partial(jax.jit, ...)``."""
+    chain = _attr_chain(expr)
+    if chain and chain[-1] == "jit":
+        return True
+    if isinstance(expr, ast.Call):
+        c = _attr_chain(expr.func)
+        if c and c[-1] == "partial":
+            return any(_is_jit_expr(a) for a in expr.args)
+    return False
+
+
+class JitPurityChecker(Checker):
+    name = "jit-purity"
+
+    def begin_module(self, ctx: Ctx) -> None:
+        self._defs: dict[str, list[ast.AST]] = {}
+        self._jit_names: set[str] = set()
+        self._inline_bodies: list[ast.AST] = []
+
+    def _def_decorated_jit(self, node) -> bool:
+        return any(_is_jit_expr(d) for d in node.decorator_list)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef, ctx: Ctx) -> None:
+        self._defs.setdefault(node.name, []).append(node)
+        if self._def_decorated_jit(node):
+            self._jit_names.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign, ctx: Ctx) -> None:
+        # X = jax.jit(f)  /  X = functools.partial(jax.jit, ...)(f)
+        v = node.value
+        if not isinstance(v, ast.Call):
+            return
+        if _is_jit_expr(v.func):
+            for a in v.args:
+                if isinstance(a, ast.Name):
+                    self._jit_names.add(a.id)
+
+    def visit_Call(self, node: ast.Call, ctx: Ctx) -> None:
+        chain = _attr_chain(node.func)
+        if not chain or chain[-1] not in _LOOP_FUNCS:
+            return
+        for a in node.args:
+            if isinstance(a, ast.Name):
+                self._jit_names.add(a.id)
+            elif isinstance(a, ast.Lambda):
+                self._inline_bodies.append(a)
+
+    # -- per-module evaluation -------------------------------------------
+    def end_module(self, ctx: Ctx) -> None:
+        bodies: list[tuple[str, ast.AST]] = []
+        for name in sorted(self._jit_names):
+            for node in self._defs.get(name, ()):
+                bodies.append((name, node))
+        for lam in self._inline_bodies:
+            bodies.append(("<lambda>", lam))
+        seen: set[int] = set()
+        for name, node in bodies:
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            self._check_body(name, node, ctx)
+
+    def _impurity(self, node: ast.AST) -> Optional[tuple[str, str]]:
+        """(symbol-suffix, message) for an impure node."""
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if not chain:
+                return None
+            tail = chain[-1]
+            if tail in _METRIC_FUNCS:
+                return (f"metric:{tail}",
+                        f"metric emit {tail}() inside a jitted body — "
+                        f"fires per compile, not per execution")
+            if tail in _IMPURE_CALL_TAILS:
+                return f"{tail}", (f"{'.'.join(chain)}() inside a jitted "
+                                   f"body: {_IMPURE_CALL_TAILS[tail]}")
+            if len(chain) >= 2 and (chain[-2], tail) in _IMPURE_CHAINS:
+                return (f"clock:{'.'.join(chain)}",
+                        f"{'.'.join(chain)}() inside a jitted body: "
+                        f"{_IMPURE_CHAINS[(chain[-2], tail)]}")
+        if isinstance(node, ast.With):
+            for item in node.items:
+                chain = _attr_chain(item.context_expr)
+                tail = chain[-1] if chain else ""
+                if "lock" in tail.lower():
+                    return (f"lock:{tail}",
+                            f"lock {'.'.join(chain)} acquired inside a "
+                            f"jitted body — trace-time locking can "
+                            f"deadlock a compile under callers' locks")
+        return None
+
+    def _check_body(self, name: str, fn: ast.AST, ctx: Ctx) -> None:
+        for node in ast.walk(fn):
+            hit = self._impurity(node)
+            if hit is None:
+                continue
+            suffix, message = hit
+            self.report(ctx.module.relpath, node.lineno,
+                        f"{name}:{suffix}", f"{name}: {message}")

@@ -1,0 +1,121 @@
+"""lock-order: the declared hierarchy, statically enforced.
+
+Two sub-rules over :mod:`.lockspec`:
+
+- **undeclared-lock** — every ``threading.Lock()``/``RLock()`` bound
+  to an attribute or module global must be declared in the spec. An
+  ad-hoc lock with no rank is a hierarchy hole: nothing checks what
+  it may nest under.
+- **order** — a ``with`` statement acquiring lock B syntactically
+  inside a ``with`` holding lock A must respect rank(A) < rank(B).
+  Same-name nesting is exempt (distinct instances of one role, e.g.
+  two aggregators' fold locks during a merge, are indistinguishable
+  statically; the runtime witness sees those).
+
+Resolution is name-based: ``self.X`` resolves against the spec entry
+for (module, enclosing class, X); cross-object references like
+``agg._fold_lock`` resolve when the attribute is unambiguous across
+the whole spec. Unresolvable expressions (plain names, call results)
+are skipped — the witness covers them at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ct_mapreduce_tpu.analysis import lockspec
+from ct_mapreduce_tpu.analysis.engine import Checker, Ctx
+
+_SCOPE_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def resolve_lock_expr(expr: ast.AST, relpath: str,
+                      cls: Optional[str]) -> Optional[tuple[str, Optional[int]]]:
+    """(hierarchy name, rank) when ``expr`` names a declared lock."""
+    if not isinstance(expr, ast.Attribute):
+        return None
+    attr = expr.attr
+    if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        d = lockspec.decl_for(relpath, cls, attr)
+        if d is not None:
+            return d.name, d.rank
+    name = lockspec.unique_attr_name(attr)
+    if name is not None:
+        return name, lockspec.rank_of(name)
+    return None
+
+
+class LockOrderChecker(Checker):
+    name = "lock-order"
+
+    # -- undeclared locks ------------------------------------------------
+    def _check_binding(self, value: ast.AST, target: ast.AST,
+                       ctx: Ctx) -> None:
+        if lockspec._lock_ctor_kind(value) is None:
+            return
+        relpath = ctx.module.relpath
+        if isinstance(target, ast.Attribute) and isinstance(
+                target.value, ast.Name) and target.value.id == "self":
+            cls, attr = ctx.cls, target.attr
+        elif isinstance(target, ast.Name) and ctx.cls is None \
+                and ctx.func is None:
+            cls, attr = None, target.id
+        else:
+            return  # local temporary; the witness still graphs it
+        if lockspec.decl_for(relpath, cls, attr) is None:
+            where = f"{cls}.{attr}" if cls else attr
+            self.report(
+                relpath, value.lineno, where,
+                f"threading lock {where} is not declared in "
+                f"analysis/lockspec.py — add a LockDecl with a rank "
+                f"(or None for an order-free leaf)")
+
+    def visit_Assign(self, node: ast.Assign, ctx: Ctx) -> None:
+        for t in node.targets:
+            self._check_binding(node.value, t, ctx)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign, ctx: Ctx) -> None:
+        if node.value is not None:
+            self._check_binding(node.value, node.target, ctx)
+
+    # -- with-nest order -------------------------------------------------
+    def _enclosing_function(self, node: ast.AST, ctx: Ctx):
+        n = node
+        while n is not None:
+            n = ctx.parent(n)
+            if isinstance(n, _SCOPE_TYPES):
+                return n
+        return None
+
+    def visit_With(self, node: ast.With, ctx: Ctx) -> None:
+        relpath, cls = ctx.module.relpath, ctx.cls
+        here = self._enclosing_function(node, ctx)
+        held: list[tuple[str, Optional[int], int]] = []
+        # Locks held by enclosing `with` blocks IN THE SAME function
+        # (a closure's body does not run under its definition site's
+        # locks).
+        for outer in ctx.with_stack:
+            if self._enclosing_function(outer, ctx) is not here:
+                continue
+            for item in outer.items:
+                r = resolve_lock_expr(item.context_expr, relpath, cls)
+                if r is not None:
+                    held.append((r[0], r[1], outer.lineno))
+        for item in node.items:
+            r = resolve_lock_expr(item.context_expr, relpath, cls)
+            if r is None:
+                continue
+            name, rank = r
+            for h_name, h_rank, h_line in held:
+                if h_name == name:
+                    continue  # same hierarchy node: witness territory
+                if h_rank is None or rank is None:
+                    continue  # order-free leaf
+                if rank <= h_rank:
+                    self.report(
+                        relpath, node.lineno, f"{h_name}->{name}",
+                        f"acquires {name} (rank {rank}) while holding "
+                        f"{h_name} (rank {h_rank}, line {h_line}) — "
+                        f"against the declared hierarchy")
+            held.append((name, rank, node.lineno))

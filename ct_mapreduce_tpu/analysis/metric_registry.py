@@ -1,0 +1,98 @@
+"""metric-registry: every emitted metric key is documented, every
+documented key is emitted.
+
+The framework generalization of what ``tests/test_metrics_doc.py``
+used to do with its own AST walk (the walker now lives here; the test
+is a thin wrapper): collect every ``incr_counter``/``set_gauge``/
+``add_sample``/``measure`` call site in the package, turn literal
+arguments into dotted keys (non-literal segments become ``*``), and
+diff against the backtick-quoted bullet entries of
+``docs/METRICS.md`` in both directions. Wildcards match either way —
+a dynamic call segment satisfies a doc wildcard and vice versa.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ct_mapreduce_tpu.analysis.engine import Checker, Ctx, Project
+
+EMIT_FUNCS = {"incr_counter", "set_gauge", "add_sample", "measure"}
+DOC_RELPATH = "docs/METRICS.md"
+# The emit API itself, not a call site.
+EXCLUDE_MODULES = ("ct_mapreduce_tpu/telemetry/metrics.py",)
+
+
+def key_matches(call_key: str, doc_key: str) -> bool:
+    """Wildcards may sit on either side: a dynamic call segment (``*``
+    from an f-string/variable) matches a doc wildcard, and a doc
+    wildcard covers literal call keys."""
+    call_re = re.escape(call_key).replace(r"\*", ".*")
+    doc_re = re.escape(doc_key).replace(r"\*", ".*")
+    return (re.fullmatch(call_re, doc_key) is not None
+            or re.fullmatch(doc_re, call_key) is not None)
+
+
+def documented_keys(doc_text: str) -> set[str]:
+    """Backtick-quoted keys from the registry's bullet lines."""
+    keys = set()
+    for line in doc_text.splitlines():
+        m = re.match(r"- `([^`]+)`", line.strip())
+        if m:
+            keys.add(m.group(1))
+    return keys
+
+
+class MetricRegistryChecker(Checker):
+    name = "metric-registry"
+
+    def __init__(self) -> None:
+        super().__init__()
+        # dotted key -> ["path:line", ...]
+        self.call_sites: dict[str, list[str]] = {}
+
+    def visit_Call(self, node: ast.Call, ctx: Ctx) -> None:
+        if ctx.module.relpath in EXCLUDE_MODULES:
+            return
+        fn = node.func
+        name = (fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None)
+        if name not in EMIT_FUNCS or not node.args:
+            return
+        parts = [
+            a.value
+            if isinstance(a, ast.Constant) and isinstance(a.value, str)
+            else "*"
+            for a in node.args
+        ]
+        where = f"{ctx.module.relpath}:{node.lineno}"
+        self.call_sites.setdefault(".".join(parts), []).append(where)
+
+    def finish(self, project: Project) -> None:
+        doc_path = project.repo_root / DOC_RELPATH
+        if not doc_path.exists():
+            self.report(DOC_RELPATH, 0, "missing",
+                        "docs/METRICS.md not found — the metric-name "
+                        "registry is the dashboard stability contract")
+            return
+        docs = documented_keys(doc_path.read_text())
+        if not docs:
+            self.report(DOC_RELPATH, 0, "empty",
+                        "docs/METRICS.md lists no keys — format changed?")
+            return
+        for key, sites in sorted(self.call_sites.items()):
+            if not any(key_matches(key, d) for d in docs):
+                path, _, line = sites[0].rpartition(":")
+                self.report(
+                    path, int(line), key,
+                    f"metric key `{key}` emitted "
+                    f"({', '.join(sites)}) but missing from "
+                    f"docs/METRICS.md — dashboards key on these names")
+        for d in sorted(docs):
+            if not any(key_matches(key, d) for key in self.call_sites):
+                self.report(
+                    DOC_RELPATH, 0, f"stale:{d}",
+                    f"docs/METRICS.md lists `{d}` but no call site "
+                    f"emits it — deleting a metric must update the "
+                    f"registry too")

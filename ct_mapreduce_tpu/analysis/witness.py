@@ -1,0 +1,347 @@
+"""Runtime lock-order witness: the declared hierarchy, checked live.
+
+Opt-in (``CTMR_LOCK_WITNESS=1``): :func:`install` replaces
+``threading.Lock``/``RLock`` with factories that wrap locks **created
+by package code** (caller-frame filtered; everything else gets a real
+lock untouched) in a thin bookkeeping shell. Each wrapped acquisition
+pushes onto a per-thread chain; first-time (held → acquired) pairs are
+recorded into a global edge graph where two checks run:
+
+- **order** — both locks declared and ranked in
+  :mod:`.lockspec`: acquiring a rank ≤ the one held violates the
+  hierarchy;
+- **cycle** — any new edge closing a directed cycle in the observed
+  graph is a deadlock shape, declared or not.
+
+Locks are *named* by creation site: the spec's
+:func:`~ct_mapreduce_tpu.analysis.lockspec.build_site_table` maps
+``(file, line)`` of every declared ``threading.Lock()`` call to its
+hierarchy name, so the witness needs no cooperation from the code it
+observes. Same-name pairs are exempt (distinct instances of one role,
+e.g. two aggregators' fold locks during a merge).
+
+Findings surface three ways: :meth:`LockWitness.findings`, a
+``lock_witness`` section in every flight-recorder dump
+(:func:`ct_mapreduce_tpu.telemetry.flight.register_section`), and —
+under the test suite — a session-failing report from
+``tests/conftest.py``, which enables the witness for the whole tier-1
+run so every concurrency test doubles as a race-order probe.
+
+Bookkeeping is wait-free on the hot path (thread-local list + one
+set lookup per held lock) and *must never raise*: a witness bug may
+lose a finding, never break the program it watches.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Optional
+
+from ct_mapreduce_tpu.analysis import lockspec
+
+# Real factories, captured before any patching.
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+MAX_FINDINGS = 100
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.realpath(__file__)))
+
+
+class WitnessLock:
+    """Duck-typed threading.Lock/RLock with acquisition bookkeeping."""
+
+    __slots__ = ("_w", "_lock", "name", "rank", "uid")
+
+    def __init__(self, witness: "LockWitness", lock, name: str,
+                 rank: Optional[int], uid: int) -> None:
+        self._w = witness
+        self._lock = lock
+        self.name = name
+        self.rank = rank
+        self.uid = uid
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            try:
+                self._w._note_acquire(self)
+            except Exception:
+                self._w._internal_errors += 1
+        return got
+
+    def release(self) -> None:
+        try:
+            self._w._note_release(self)
+        except Exception:
+            self._w._internal_errors += 1
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "WitnessLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<WitnessLock {self.name} rank={self.rank}>"
+
+
+class LockWitness:
+    """The edge graph + per-thread chains. One instance is installed
+    process-wide by :func:`install`; tests build private instances
+    around :meth:`wrap` to inject violations without polluting it."""
+
+    def __init__(self, site_table: Optional[dict] = None,
+                 ranks: Optional[dict] = None) -> None:
+        self.site_table = site_table or {}
+        self.ranks = dict(lockspec.RANKS if ranks is None else ranks)
+        self._tl = threading.local()
+        self._ilock = _ORIG_LOCK()  # guards graph + findings; REAL lock
+        self._edge_seen: set[tuple[str, str]] = set()
+        self._edges: dict[str, set[str]] = {}
+        self._edge_where: dict[tuple[str, str], str] = {}
+        self._violations: list[dict] = []
+        self._uid = 0
+        self._internal_errors = 0
+        self.locks_wrapped = 0
+
+    # -- wrapping --------------------------------------------------------
+    def wrap(self, lock, name: str,
+             rank: Optional[int] = None) -> WitnessLock:
+        with self._ilock:
+            self._uid += 1
+            uid = self._uid
+            self.locks_wrapped += 1
+        if rank is None:
+            rank = self.ranks.get(name)
+        return WitnessLock(self, lock, name, rank, uid)
+
+    # -- hot path --------------------------------------------------------
+    def _note_acquire(self, wl: WitnessLock) -> None:
+        tl = self._tl
+        try:
+            stack = tl.stack
+            counts = tl.counts
+        except AttributeError:
+            stack = tl.stack = []
+            counts = tl.counts = {}
+        n = counts.get(wl.uid, 0)
+        counts[wl.uid] = n + 1
+        if n:  # reentrant re-acquire (RLock): chain position unchanged
+            return
+        if stack:
+            seen = self._edge_seen
+            for held in stack:
+                if held.name != wl.name and (
+                        held.name, wl.name) not in seen:
+                    self._record_edge(held, wl)
+        stack.append(wl)
+
+    def _note_release(self, wl: WitnessLock) -> None:
+        tl = self._tl
+        try:
+            counts = tl.counts
+            stack = tl.stack
+        except AttributeError:
+            return  # release from a thread that never acquired: ignore
+        n = counts.get(wl.uid, 0)
+        if n > 1:
+            counts[wl.uid] = n - 1
+            return
+        counts.pop(wl.uid, None)
+        if stack and stack[-1] is wl:
+            stack.pop()
+        else:  # legal out-of-LIFO release
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] is wl:
+                    del stack[i]
+                    break
+
+    # -- slow path: first observation of a (held, acquired) pair ---------
+    @staticmethod
+    def _acquire_site() -> str:
+        f = sys._getframe(2)
+        here = os.path.abspath(__file__)
+        while f is not None and os.path.abspath(
+                f.f_code.co_filename) == here:
+            f = f.f_back
+        if f is None:  # pragma: no cover
+            return "?"
+        return f"{f.f_code.co_filename}:{f.f_lineno}"
+
+    def _record_edge(self, held: WitnessLock, wl: WitnessLock) -> None:
+        where = self._acquire_site()
+        thread = threading.current_thread().name
+        with self._ilock:
+            key = (held.name, wl.name)
+            if key in self._edge_seen:
+                return
+            self._edge_seen.add(key)
+            self._edge_where[key] = where
+            self._edges.setdefault(held.name, set()).add(wl.name)
+            if (held.rank is not None and wl.rank is not None
+                    and wl.rank <= held.rank):
+                self._add_violation({
+                    "kind": "order",
+                    "held": held.name,
+                    "held_rank": held.rank,
+                    "acquiring": wl.name,
+                    "acquiring_rank": wl.rank,
+                    "thread": thread,
+                    "where": where,
+                })
+            cycle = self._find_cycle(wl.name, held.name)
+            if cycle is not None:
+                self._add_violation({
+                    "kind": "cycle",
+                    "cycle": cycle + [wl.name],
+                    "closing_edge": f"{held.name}->{wl.name}",
+                    "thread": thread,
+                    "where": where,
+                })
+
+    def _find_cycle(self, src: str, dst: str) -> Optional[list]:
+        """Path src →* dst in the edge graph (the new edge dst←...→src
+        already inserted closes it into a cycle). Iterative DFS."""
+        stack = [(src, [src])]
+        visited = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for succ in self._edges.get(node, ()):
+                if succ not in visited:
+                    visited.add(succ)
+                    stack.append((succ, path + [succ]))
+        return None
+
+    def _add_violation(self, v: dict) -> None:
+        if len(self._violations) < MAX_FINDINGS:
+            self._violations.append(v)
+
+    # -- reporting -------------------------------------------------------
+    def findings(self) -> list[dict]:
+        with self._ilock:
+            return list(self._violations)
+
+    def edges(self) -> dict[str, list[str]]:
+        with self._ilock:
+            return {k: sorted(v) for k, v in self._edges.items()}
+
+    def reset(self) -> None:
+        with self._ilock:
+            self._violations.clear()
+            self._edges.clear()
+            self._edge_seen.clear()
+            self._edge_where.clear()
+
+    def report(self) -> dict:
+        """The flight-recorder section."""
+        with self._ilock:
+            return {
+                "violations": list(self._violations),
+                "edge_count": len(self._edge_seen),
+                "edges": {a: sorted(bs)
+                          for a, bs in sorted(self._edges.items())},
+                "locks_wrapped": self.locks_wrapped,
+                "internal_errors": self._internal_errors,
+            }
+
+
+# -- process-wide installation -------------------------------------------
+
+_active: Optional[LockWitness] = None
+_patched = False
+
+
+def enabled_by_env(env: Optional[dict] = None) -> bool:
+    env = os.environ if env is None else env
+    return str(env.get("CTMR_LOCK_WITNESS", "")).strip().lower() in (
+        "1", "t", "true")
+
+
+def active() -> Optional[LockWitness]:
+    return _active
+
+
+def _resolve(fname: str, _cache: dict = {}) -> str:
+    r = _cache.get(fname)
+    if r is None:
+        r = _cache[fname] = os.path.realpath(fname)
+    return r
+
+
+def _factory(kind: str):
+    orig = _ORIG_LOCK if kind == "lock" else _ORIG_RLOCK
+
+    def make_lock():
+        real = orig()
+        w = _active
+        if w is None:
+            return real
+        try:
+            f = sys._getframe(1)
+            fname = _resolve(f.f_code.co_filename)
+            if not fname.startswith(_PKG_DIR + os.sep):
+                return real
+            named = w.site_table.get((fname, f.f_lineno))
+            if named is not None:
+                name, rank = named
+            else:
+                rel = os.path.relpath(fname, os.path.dirname(_PKG_DIR))
+                name, rank = f"{rel}:{f.f_lineno}", None
+            return w.wrap(real, name, rank)
+        except Exception:
+            return real
+
+    make_lock.__name__ = f"witness_{kind}_factory"
+    return make_lock
+
+
+def install(force: bool = False) -> Optional[LockWitness]:
+    """Install the process-wide witness when ``CTMR_LOCK_WITNESS`` is
+    truthy (or ``force``). Idempotent; returns the active witness (or
+    None when disabled). Must run before the package modules whose
+    locks it should observe create them — already-created locks simply
+    go unwitnessed."""
+    global _active, _patched
+    if _active is not None:
+        return _active
+    if not force and not enabled_by_env():
+        return None
+    w = LockWitness(site_table=lockspec.build_site_table(_PKG_DIR))
+    _active = w
+    if not _patched:
+        threading.Lock = _factory("lock")
+        threading.RLock = _factory("rlock")
+        _patched = True
+    try:
+        from ct_mapreduce_tpu.telemetry import flight
+
+        flight.register_section("lock_witness", w.report)
+    except Exception:  # flight recorder is optional here
+        pass
+    return w
+
+
+def uninstall() -> None:
+    """Restore the real factories (test hygiene). Locks already
+    wrapped keep working — they hold real locks inside."""
+    global _active, _patched
+    _active = None
+    if _patched:
+        threading.Lock = _ORIG_LOCK
+        threading.RLock = _ORIG_RLOCK
+        _patched = False
+    try:
+        from ct_mapreduce_tpu.telemetry import flight
+
+        flight.unregister_section("lock_witness")
+    except Exception:
+        pass

@@ -212,6 +212,13 @@ def main(argv: list[str] | None = None) -> int:
     # re-entered by tests and runForever wrappers). Uninstalled in the
     # finally for the same reason.
     flight.install(signals=False, excepthook=False)
+    # Lock-order witness (round 16): CTMR_LOCK_WITNESS=1 wraps every
+    # lock the package creates from here on; order violations and
+    # cycles land in this run's flight dumps as a `lock_witness`
+    # section (docs/ANALYSIS.md). No-op unless the env opts in.
+    from ct_mapreduce_tpu.analysis import witness as _witness
+
+    _witness.install()
     if config.issuer_cn_filter:
         # The reference logs a stale "unsupported" warning here
         # (ct-fetch.go:498-499) but enforces the filter anyway; we just

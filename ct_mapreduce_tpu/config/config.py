@@ -360,6 +360,14 @@ class CTConfig:
             "filterFpRate = target layer-0 false-positive rate of the "
             "filter cascade (CTMR_FILTER_FP_RATE equivalent; default "
             "0.01; included serials are exact regardless)",
+            "",
+            "Diagnostics (env only):",
+            "CTMR_LOCK_WITNESS=1 wraps every lock the package creates "
+            "in the runtime lock-order witness (analysis/witness.py): "
+            "acquisition chains are checked live against the declared "
+            "hierarchy (analysis/lockspec.py) and findings land in "
+            "flight-recorder dumps. See docs/ANALYSIS.md; `ctmrlint` "
+            "is the static half.",
         ]
         return "\n".join(lines)
 

@@ -307,7 +307,9 @@ def capture_by_identity(capture: dict, registry) -> dict:
     past the registry (impossible in a consistent state) fail loudly —
     an artifact must never silently drop a group."""
     out: dict = {}
-    for (idx, eh), serials in capture.items():
+    # Sorted so the identity-keyed dict's insertion order is a function
+    # of content, not capture fold order (ctmrlint: determinism).
+    for (idx, eh), serials in sorted(capture.items()):
         if not serials:
             continue
         iss = registry.issuer_at(int(idx)).id()
@@ -329,7 +331,7 @@ def build_from_aggregator(agg, fp_rate: float = DEFAULT_FP_RATE,
     lock = getattr(agg, "_fold_lock", None)
     with (lock if lock is not None else contextlib.nullcontext()):
         capture = {key: set(serials)
-                   for key, serials in agg.filter_capture.items()}
+                   for key, serials in sorted(agg.filter_capture.items())}
     return build_artifact(
         capture_by_identity(capture, agg.registry),
         fp_rate=fp_rate, use_device=use_device)

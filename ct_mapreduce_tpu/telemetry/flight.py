@@ -94,6 +94,14 @@ class FlightRecorder:
                     "metric_snapshots": list(self._snaps),
                     "current_metrics": current,
                 }
+                # Registered extension sections (e.g. the lock
+                # witness, analysis/witness.py): best-effort, a
+                # provider failure must not lose the dump.
+                for name, provider in list(_sections.items()):
+                    try:
+                        doc[name] = provider()
+                    except Exception:
+                        doc[name] = {"error": "section provider failed"}
                 os.makedirs(self.dir, exist_ok=True)
                 with open(path, "w") as fh:
                     json.dump(doc, fh)
@@ -108,6 +116,19 @@ class FlightRecorder:
 _recorder: Optional[FlightRecorder] = None
 _prev_excepthook = None
 _prev_signals: dict[int, object] = {}
+
+# Extension sections merged into every dump: name -> zero-arg provider
+# returning a JSON-serializable value. The lock witness registers
+# "lock_witness" here; others may follow.
+_sections: dict[str, object] = {}
+
+
+def register_section(name: str, provider) -> None:
+    _sections[name] = provider
+
+
+def unregister_section(name: str) -> None:
+    _sections.pop(name, None)
 
 
 def get_recorder() -> Optional[FlightRecorder]:

@@ -24,6 +24,17 @@ if os.environ.get("CT_TPU_TESTS", "") == "":
 
 import pytest  # noqa: E402
 
+# Lock-order witness across the WHOLE suite (round 16): every
+# concurrency test doubles as a race-order probe against the declared
+# hierarchy (analysis/lockspec.py). Installed before any package
+# module creates a lock; CTMR_LOCK_WITNESS=0 opts a run out.
+# pytest_sessionfinish below fails the run on any order violation or
+# cycle the witness observed.
+os.environ.setdefault("CTMR_LOCK_WITNESS", "1")
+from ct_mapreduce_tpu.analysis import witness as _lock_witness  # noqa: E402
+
+_lock_witness.install()
+
 
 def on_tpu() -> bool:
     import jax
@@ -49,3 +60,32 @@ def pytest_configure(config):
         "slow: outside the tier-1 budget (tier-1 runs -m 'not slow'); "
         "e.g. per-batch-width ECDSA kernel compiles",
     )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The suite-wide lock-witness gate: zero order violations or
+    cycles across everything the tier-1 run exercised."""
+    w = _lock_witness.active()
+    if w is None:
+        return
+    findings = w.findings()
+    if not findings:
+        return
+    lines = ["", "=" * 70,
+             "LOCK WITNESS: order violations / cycles observed:"]
+    for v in findings:
+        if v.get("kind") == "order":
+            lines.append(
+                f"  order: {v['held']} (rank {v['held_rank']}) held "
+                f"while acquiring {v['acquiring']} "
+                f"(rank {v['acquiring_rank']}) [{v['thread']}] at "
+                f"{v['where']}")
+        else:
+            lines.append(
+                f"  cycle: {' -> '.join(v.get('cycle', []))} "
+                f"[{v['thread']}] at {v['where']}")
+    lines.append("(hierarchy: ct_mapreduce_tpu/analysis/lockspec.py; "
+                 "docs/ANALYSIS.md)")
+    lines.append("=" * 70)
+    print("\n".join(lines))
+    session.exitstatus = 1

@@ -1,0 +1,403 @@
+"""Round-16 analysis framework tests: engine mechanics, each checker
+on synthetic violations, baseline workflow, and the runtime lock
+witness self-test (injected order violation + 2-lock cycle, both
+landing in a flight-recorder dump).
+
+Witness self-tests use PRIVATE LockWitness instances so the injected
+violations never pollute the suite-wide witness that conftest gates
+the session on."""
+
+import json
+import pathlib
+import textwrap
+import threading
+
+import pytest
+
+from ct_mapreduce_tpu.analysis import lockspec, witness
+from ct_mapreduce_tpu.analysis.config_parity import ConfigParityChecker
+from ct_mapreduce_tpu.analysis.determinism import DeterminismChecker
+from ct_mapreduce_tpu.analysis.donation import DonationChecker
+from ct_mapreduce_tpu.analysis.engine import (
+    AnalysisEngine,
+    apply_baseline,
+    load_baseline,
+)
+from ct_mapreduce_tpu.analysis.jit_purity import JitPurityChecker
+from ct_mapreduce_tpu.analysis.lock_order import LockOrderChecker
+from ct_mapreduce_tpu.analysis.metric_registry import MetricRegistryChecker
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def run_on(tmp_path, files: dict, checkers, pkg="ct_mapreduce_tpu"):
+    """Write a synthetic package (named like the real one so checker
+    scope patterns match) and run the engine over it."""
+    root = tmp_path / pkg
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    engine = AnalysisEngine(checkers)
+    return engine.run(root)
+
+
+# -- lock-order ----------------------------------------------------------
+
+def test_lock_order_flags_undeclared_lock(tmp_path):
+    findings = run_on(tmp_path, {"serve/extra.py": """
+        import threading
+
+        class Thing:
+            def __init__(self):
+                self._adhoc_lock = threading.Lock()
+        """}, [LockOrderChecker()])
+    assert [f for f in findings if f.rule == "lock-order"
+            and "Thing._adhoc_lock" in f.symbol]
+
+
+def test_lock_order_flags_inverted_nest(tmp_path):
+    findings = run_on(tmp_path, {"agg/x.py": """
+        def bad(agg):
+            with agg._table_lock:
+                with agg._fold_lock:
+                    pass
+
+        def good(agg):
+            with agg._fold_lock:
+                with agg._table_lock:
+                    pass
+        """}, [LockOrderChecker()])
+    bad = [f for f in findings if f.symbol == "agg.table->agg.fold"]
+    assert bad and "rank" in bad[0].message
+    assert not [f for f in findings if f.symbol == "agg.fold->agg.table"]
+
+
+def test_lock_order_multi_item_with_and_closure_scope(tmp_path):
+    findings = run_on(tmp_path, {"agg/y.py": """
+        def multi(agg):
+            with agg._save_lock, agg._dispatch_lock:  # 24 then 20: bad
+                pass
+
+        def closure(agg):
+            with agg._fold_lock:
+                def later():
+                    with agg._dispatch_lock:  # runs outside the fold
+                        pass
+                return later
+        """}, [LockOrderChecker()])
+    assert [f for f in findings if f.symbol == "agg.save->ingest.dispatch"]
+    assert not [f for f in findings
+                if f.symbol == "agg.fold->ingest.dispatch"]
+
+
+def test_lockspec_covers_every_package_lock():
+    """The undeclared-lock sub-rule over the REAL package: the spec in
+    lockspec.py declares every threading lock (zero live findings is
+    the ctmrlint gate; here we pin the inventory is non-trivial)."""
+    checker = LockOrderChecker()
+    AnalysisEngine([checker]).run(REPO / "ct_mapreduce_tpu")
+    undeclared = [f for f in checker.findings if "not declared" in f.message]
+    assert not undeclared, "\n".join(f.render() for f in undeclared)
+    table = lockspec.build_site_table(REPO / "ct_mapreduce_tpu")
+    assert len(table) >= 25  # ~30 locks across 15 modules (ISSUE 11)
+
+
+# -- donation-safety -----------------------------------------------------
+
+def test_donation_flags_use_after_donate(tmp_path):
+    findings = run_on(tmp_path, {"ops/z.py": """
+        def bad(table, rows):
+            table, out = ingest_step_donated(table, rows, 3)
+            return rows.sum()
+
+        def good(table, rows):
+            table, out = ingest_step_donated(table, rows, 3)
+            return table, out
+        """}, [DonationChecker()])
+    assert [f for f in findings if f.symbol == "bad:rows"]
+    assert not [f for f in findings if "good" in f.symbol]
+
+
+def test_donation_tracks_conditional_alias_and_self_attrs(tmp_path):
+    findings = run_on(tmp_path, {"agg/w.py": """
+        def aliased(self, data):
+            step = (ingest_step_donated if fast else ingest_step)
+            self.table, out = step(self.table, data, 1)
+            return data.nbytes  # data donated via the alias
+
+        def reassigned(self, data):
+            step = ingest_step_donated
+            self.table, out = step(self.table, data, 1)
+            data = out.fresh
+            return data.nbytes
+        """}, [DonationChecker()])
+    assert [f for f in findings if f.symbol == "aliased:data"]
+    assert not [f for f in findings if f.symbol.startswith("reassigned")]
+
+
+# -- determinism ---------------------------------------------------------
+
+def test_determinism_rules(tmp_path):
+    findings = run_on(tmp_path, {"filter/artifact.py": """
+        import time, random
+
+        def serialize(groups):
+            stamp = time.time()  # flagged
+            salt = random.random()  # flagged
+            for k, v in groups.items():  # flagged
+                emit(k, v)
+            for k in sorted(groups.items()):  # fine
+                emit(k)
+            total = sum(len(v) for v in groups.values())  # order-free
+            return stamp, salt, total
+        """}, [DeterminismChecker()])
+    kinds = {f.symbol.split(":")[1] for f in findings}
+    assert kinds == {"clock", "random", "unsorted"}
+    assert len([f for f in findings if ":unsorted:" in f.symbol]) == 1
+
+
+def test_determinism_out_of_scope_module_is_silent(tmp_path):
+    findings = run_on(tmp_path, {"ingest/anything.py": """
+        import time
+
+        def poll():
+            return time.time()
+        """}, [DeterminismChecker()])
+    assert findings == []
+
+
+# -- jit-purity ----------------------------------------------------------
+
+def test_jit_purity(tmp_path):
+    findings = run_on(tmp_path, {"ops/k.py": """
+        import jax, functools
+
+        def core(x):
+            print("tracing")  # flagged
+            incr_counter("a", "b")  # flagged
+            return x + 1
+
+        step = functools.partial(jax.jit, donate_argnums=(0,))(core)
+
+        def loop(x):
+            def body(i, c):
+                with self._table_lock:  # flagged
+                    return c
+            return jax.lax.fori_loop(0, 4, body, x)
+
+        def host_only(x):
+            print("fine outside jit")
+            return x
+        """}, [JitPurityChecker()])
+    syms = {f.symbol for f in findings}
+    assert "core:print" in syms
+    assert "core:metric:incr_counter" in syms
+    assert "body:lock:_table_lock" in syms
+    assert not any(s.startswith("host_only") for s in syms)
+
+
+# -- metric-registry / config-parity (synthetic) -------------------------
+
+def test_metric_registry_checker(tmp_path):
+    root = tmp_path / "ct_mapreduce_tpu"
+    root.mkdir()
+    (root / "m.py").write_text(
+        "incr_counter('lane', 'hits')\nset_gauge('lane', 'depth')\n")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "METRICS.md").write_text(
+        "- `lane.hits` — counter\n- `lane.ghost` — counter\n")
+    checker = MetricRegistryChecker()
+    AnalysisEngine([checker]).run(root)
+    syms = {f.symbol for f in checker.findings}
+    assert "lane.depth" in syms  # emitted, undocumented
+    assert "stale:lane.ghost" in syms  # documented, never emitted
+    assert not any(s == "lane.hits" for s in syms)
+
+
+def test_config_parity_checker(tmp_path):
+    files = {
+        "config/config.py": """
+            class CTConfig:
+                _DIRECTIVES = {
+                    "alpha": ("alpha", int),
+                    "beta": ("beta", str),
+                    "certPath": ("cert_path", str),
+                }
+
+                def usage(self):
+                    lines = [
+                        "alpha = the alpha knob",
+                        "ghost = documented but unparsed",
+                    ]
+                    return "\\n".join(lines)
+            """,
+        "serve/s.py": """
+            import os
+
+            def resolve_thing(v=None):
+                return v or os.environ.get("CTMR_THING", "")
+            """,
+    }
+    root = tmp_path / "ct_mapreduce_tpu"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    (tmp_path / "MIGRATING.md").write_text("alpha is documented here\n")
+    checker = ConfigParityChecker()
+    AnalysisEngine([checker]).run(root)
+    syms = {f.symbol for f in checker.findings}
+    assert "usage:beta" in syms  # parsed, not in usage()
+    assert "usage-unknown:ghost" in syms  # usage() ghost
+    assert "migrating:beta" in syms  # TPU-native, not in MIGRATING
+    assert "migrating-env:CTMR_THING" in syms
+    assert "migrating:certPath" not in syms  # reference directive
+    assert "usage:alpha" not in syms
+
+
+# -- baseline ------------------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    from ct_mapreduce_tpu.analysis.engine import Finding
+    base = tmp_path / "b.baseline"
+    base.write_text(
+        "# comment\n"
+        "ruleA:pkg/x.py:sym | known quirk, tracked in ISSUE 99\n"
+        "ruleB:pkg/y.py:gone | stale entry\n")
+    entries = load_baseline(base)
+    assert entries["ruleA:pkg/x.py:sym"].startswith("known quirk")
+    live, suppressed, unused = apply_baseline(
+        [Finding("ruleA", "pkg/x.py", 3, "sym", "m"),
+         Finding("ruleA", "pkg/x.py", 9, "other", "m2")], entries)
+    assert [f.symbol for f in live] == ["other"]
+    assert [f.symbol for f in suppressed] == ["sym"]
+    assert unused == ["ruleB:pkg/y.py:gone"]
+
+
+def test_baseline_requires_justification(tmp_path):
+    base = tmp_path / "b.baseline"
+    base.write_text("ruleA:pkg/x.py:sym\n")
+    with pytest.raises(ValueError, match="justification"):
+        load_baseline(base)
+
+
+# -- runtime witness -----------------------------------------------------
+
+def make_witness():
+    """Private instance with a tiny two-lock spec (never touches the
+    installed suite-wide witness)."""
+    w = witness.LockWitness(ranks={"t.outer": 10, "t.inner": 20})
+    outer = w.wrap(threading.Lock(), "t.outer")
+    inner = w.wrap(threading.Lock(), "t.inner")
+    return w, outer, inner
+
+
+def test_witness_clean_order_and_reentrancy():
+    w, outer, inner = make_witness()
+    with outer:
+        with inner:
+            pass
+    r = w.wrap(threading.RLock(), "t.r")
+    with r:
+        with r:  # reentrant: no self-edge, no violation
+            pass
+    assert w.findings() == []
+    assert w.edges() == {"t.outer": ["t.inner"]}
+
+
+def test_witness_detects_out_of_order_acquisition():
+    w, outer, inner = make_witness()
+    with inner:
+        with outer:  # rank 20 held, acquiring rank 10
+            pass
+    v = w.findings()
+    assert len(v) == 1 and v[0]["kind"] == "order"
+    assert v[0]["held"] == "t.inner" and v[0]["acquiring"] == "t.outer"
+    assert "test_analysis.py" in v[0]["where"]
+
+
+def test_witness_detects_two_lock_cycle():
+    w = witness.LockWitness(ranks={})  # unranked: pure cycle detection
+    a = w.wrap(threading.Lock(), "t.a")
+    b = w.wrap(threading.Lock(), "t.b")
+    with a:
+        with b:
+            pass
+    done = threading.Event()
+
+    def other():  # opposite order from a second thread
+        with b:
+            with a:
+                pass
+        done.set()
+
+    threading.Thread(target=other, daemon=True).start()
+    assert done.wait(5.0)
+    v = [f for f in w.findings() if f["kind"] == "cycle"]
+    assert len(v) == 1
+    assert v[0]["closing_edge"] == "t.b->t.a"
+    assert v[0]["cycle"][0] == v[0]["cycle"][-1] == "t.a"
+
+
+def test_witness_nonblocking_and_out_of_lifo():
+    w, outer, inner = make_witness()
+    assert outer.acquire(blocking=False)
+    assert inner.acquire(blocking=False)
+    outer.release()  # out-of-LIFO: legal, bookkeeping must survive
+    inner.release()
+    assert not outer.locked() and not inner.locked()
+    assert w.findings() == []
+
+
+def test_witness_findings_land_in_flight_dump(tmp_path):
+    """Satellite 3's second half: injected violations flow through the
+    existing flight recorder as a dump section."""
+    from ct_mapreduce_tpu.telemetry import flight
+
+    w, outer, inner = make_witness()
+    with inner:
+        with outer:
+            pass
+    with w.wrap(threading.Lock(), "t.a"):
+        with w.wrap(threading.Lock(), "t.b"):
+            pass
+    flight.install(dir_path=str(tmp_path), signals=False,
+                   excepthook=False)
+    flight.register_section("lock_witness_selftest", w.report)
+    try:
+        path = flight.dump("witness self-test")
+        assert path is not None
+        doc = json.loads(pathlib.Path(path).read_text())
+        section = doc["lock_witness_selftest"]
+        assert section["violations"] and \
+            section["violations"][0]["kind"] == "order"
+        assert section["edge_count"] >= 2
+        assert any("t.a" in a or "t.b" in a for a in section["edges"])
+    finally:
+        flight.unregister_section("lock_witness_selftest")
+        flight.uninstall()
+
+
+def test_suite_witness_wraps_package_locks():
+    """End-to-end: under the conftest-installed witness, locks created
+    by package code are WitnessLocks named from the lockspec site
+    table."""
+    w = witness.active()
+    if w is None:
+        pytest.skip("CTMR_LOCK_WITNESS=0 for this run")
+    from ct_mapreduce_tpu.agg.aggregator import IssuerRegistry
+
+    reg = IssuerRegistry()
+    assert isinstance(reg._lock, witness.WitnessLock)
+    assert reg._lock.name == "agg.registry"
+    assert reg._lock.rank == lockspec.rank_of("agg.registry")
+
+
+def test_lockspec_rank_table_is_consistent():
+    """Every ranked decl resolves; the documented trunk order holds."""
+    assert lockspec.rank_of("ingest.dispatch") < lockspec.rank_of(
+        "agg.save") < lockspec.rank_of("agg.pending") < \
+        lockspec.rank_of("agg.fold") < lockspec.rank_of("agg.table")
+    assert lockspec.unique_attr_name("_fold_lock") == "agg.fold"
+    assert lockspec.unique_attr_name("_lock") is None  # ambiguous

@@ -1,95 +1,49 @@
 """Metric-name registry check: every metric key emitted anywhere in
 ct_mapreduce_tpu/ must be documented in docs/METRICS.md (the
 name-stability contract of telemetry/metrics.py:8-10, made
-enforceable — prevents silent dashboard drift)."""
+enforceable — prevents silent dashboard drift).
 
-import ast
+Round 16: the AST walk that used to live here is now the framework's
+``metric-registry`` checker (ct_mapreduce_tpu/analysis/
+metric_registry.py) — one walker shared by this gate and the
+``ctmrlint`` CLI; these tests are thin assertions over its findings,
+split by direction so a failure names the drift kind."""
+
 import pathlib
-import re
+
+from ct_mapreduce_tpu.analysis.engine import AnalysisEngine
+from ct_mapreduce_tpu.analysis.metric_registry import MetricRegistryChecker
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 PKG = REPO / "ct_mapreduce_tpu"
-DOC = REPO / "docs" / "METRICS.md"
-
-EMIT_FUNCS = {"incr_counter", "set_gauge", "add_sample", "measure"}
 
 
-def call_site_keys() -> dict[str, list[str]]:
-    """Dotted key pattern -> ["path:line", ...] for every metric-emit
-    call in the package; non-literal argument segments become ``*``."""
-    keys: dict[str, list[str]] = {}
-    for path in sorted(PKG.rglob("*.py")):
-        if path.name == "metrics.py":
-            continue  # the emit API itself, not a call site
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            name = (fn.attr if isinstance(fn, ast.Attribute)
-                    else fn.id if isinstance(fn, ast.Name) else None)
-            if name not in EMIT_FUNCS or not node.args:
-                continue
-            parts = [
-                a.value
-                if isinstance(a, ast.Constant) and isinstance(a.value, str)
-                else "*"
-                for a in node.args
-            ]
-            where = f"{path.relative_to(REPO)}:{node.lineno}"
-            keys.setdefault(".".join(parts), []).append(where)
-    return keys
-
-
-def documented_keys() -> set[str]:
-    """Backtick-quoted keys from the registry's bullet lines."""
-    keys = set()
-    for line in DOC.read_text().splitlines():
-        m = re.match(r"- `([^`]+)`", line.strip())
-        if m:
-            keys.add(m.group(1))
-    return keys
-
-
-def _matches(call_key: str, doc_key: str) -> bool:
-    """Wildcards may sit on either side: a dynamic call segment (``*``
-    from an f-string/variable) matches a doc wildcard, and a doc
-    wildcard covers literal call keys."""
-    call_re = re.escape(call_key).replace(r"\*", ".*")
-    doc_re = re.escape(doc_key).replace(r"\*", ".*")
-    return (re.fullmatch(call_re, doc_key) is not None
-            or re.fullmatch(doc_re, call_key) is not None)
+def run_registry_check():
+    checker = MetricRegistryChecker()
+    AnalysisEngine([checker]).run(PKG)
+    return checker
 
 
 def test_every_emitted_key_is_documented():
-    emitted = call_site_keys()
-    assert emitted, "AST walk found no metric call sites — test broken?"
-    docs = documented_keys()
-    assert docs, f"{DOC} lists no keys — format changed?"
-    missing = {
-        key: sites
-        for key, sites in emitted.items()
-        if not any(_matches(key, d) for d in docs)
-    }
+    checker = run_registry_check()
+    assert checker.call_sites, (
+        "AST walk found no metric call sites — checker broken?")
+    missing = [f for f in checker.findings
+               if not f.symbol.startswith("stale:")]
     assert not missing, (
         "metric keys emitted but missing from docs/METRICS.md "
         "(add them there — dashboards key on these names):\n"
-        + "\n".join(f"  {k}  ({', '.join(v)})"
-                    for k, v in sorted(missing.items()))
+        + "\n".join(f"  {f.render()}" for f in missing)
     )
 
 
 def test_documented_keys_still_emitted():
-    """The reverse direction as a WARNING-grade check: a documented key
-    no one emits anymore is stale. Kept strict — deleting a metric
-    must update the registry too."""
-    emitted = call_site_keys()
-    docs = documented_keys()
-    stale = {
-        d for d in docs
-        if not any(_matches(key, d) for key in emitted)
-    }
+    """The reverse direction: a documented key no one emits anymore is
+    stale. Kept strict — deleting a metric must update the registry
+    too."""
+    checker = run_registry_check()
+    stale = [f for f in checker.findings if f.symbol.startswith("stale:")]
     assert not stale, (
-        "docs/METRICS.md lists keys no call site emits (stale entries):"
-        f" {sorted(stale)}"
+        "docs/METRICS.md lists keys no call site emits (stale entries):\n"
+        + "\n".join(f"  {f.render()}" for f in stale)
     )

@@ -1,0 +1,22 @@
+#!/usr/bin/env python3
+"""ctmrlint — project-invariant static analysis over ct_mapreduce_tpu.
+
+Thin launcher for ``ct_mapreduce_tpu.analysis.cli`` (also installed as
+the ``ctmrlint`` console script). Run from the repo root:
+
+    python tools/ctmrlint.py                # text report, exit 0/1/2
+    python tools/ctmrlint.py --json         # machine-readable
+    python tools/ctmrlint.py --rules lock-order,determinism
+
+See docs/ANALYSIS.md for the rule set and the baseline workflow.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ct_mapreduce_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
