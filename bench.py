@@ -1737,25 +1737,28 @@ def run_smoke() -> dict:
 
 
 def run_verify_smoke() -> dict:
-    """CT_BENCH_SMOKE verify leg (round 13): the signature-
+    """CT_BENCH_SMOKE verify leg (rounds 13 + 17): the signature-
     verification lane under the staged device queue, CPU-only.
 
-    A mixed corpus — P-256 SCTs (valid and corrupted), P-384 and RSA
-    SCTs (host-fallback lanes), SCT-less certs, and unknown-log SCTs —
-    replays through the SAME AggregatorSink machinery with
-    ``verifySignatures`` on and ``chunksPerDispatch`` 2, and enforces:
+    A mixed corpus — P-256 SCTs (valid and corrupted), P-384 SCTs
+    (device lanes since round 17), RSA SCTs (host fallback), SCT-less
+    certs, and unknown-log SCTs — replays through the SAME
+    AggregatorSink machinery with ``verifySignatures`` on and
+    ``chunksPerDispatch`` 2, and enforces:
 
       (1) verdict parity EXACT: per-outcome totals equal the truth
           recomputed independently per lane with the pure-python
           reference verifier;
-      (2) the device kernel really ran and batched: span-counted
+      (2) the device kernels really ran and batched: span-counted
           ``device.verify`` executions with mean lanes/execution > 1;
       (3) the fallback lane count equals the undecidable-lane count
           (every lane the extractor or key registry routed around the
-          device kernel — none silently dropped, none double-judged).
+          device kernels — none silently dropped, none double-judged);
+      (4) the windowed precompute really engaged: qtable hits > 0 and
+          exactly one qtable miss per distinct device log key.
 
     Device batches pad to width 32 (the tier-1 parity suite's compiled
-    width, so one process compiles the ladder once).
+    width, so one process compiles each kernel once).
     """
     import base64
     import tempfile
@@ -1805,7 +1808,7 @@ def run_verify_smoke() -> dict:
             truth["verified" if kind != 3 else "failed"] += 1
         elif kind == 4:
             der = sctlib.attach_sct(base, p384, 10**12 + s)
-            truth["fallback"] += 1
+            truth["device"] += 1  # P-384 rides the device since r17
             truth["verified"] += 1
         elif kind == 5:
             der = sctlib.attach_sct(base, rsa, 10**12 + s,
@@ -1867,6 +1870,19 @@ def run_verify_smoke() -> dict:
     if (sum(v for v, _ in per_issuer.values()) != truth["verified"]
             or sum(f for _, f in per_issuer.values()) != truth["failed"]):
         raise BenchError(f"verify smoke per-issuer fold: {per_issuer}")
+    # Round 17: the windowed precompute must really engage — one
+    # qtable miss per distinct device log key (p256 + p384), hits for
+    # every further lane under those keys, occupancy surfaced.
+    if st["qtable_misses"] != 2 or st["qtable_hits"] \
+            != truth["device"] - 2:
+        raise BenchError(
+            f"verify smoke qtable: misses={st['qtable_misses']} "
+            f"hits={st['qtable_hits']} over {truth['device']} device "
+            f"lanes / 2 keys")
+    health = sink.verifier.health()
+    if health["qtable"]["p256"]["occupancy"] != 1 \
+            or health["qtable"]["p384"]["occupancy"] != 1:
+        raise BenchError(f"verify smoke occupancy: {health['qtable']}")
     if owns_trace:
         ttrace.disable()
 
@@ -1887,6 +1903,9 @@ def run_verify_smoke() -> dict:
         "smoke_verify_no_key": st["no_key"],
         "smoke_verify_device_execs": len(vspans),
         "smoke_verify_mean_batch_lanes": mean_lanes,
+        "smoke_verify_qtable_hits": st["qtable_hits"],
+        "smoke_verify_qtable_misses": st["qtable_misses"],
+        "smoke_verify_window": sink.verifier.window,
         "smoke_verify_wall_s": wall,
     }
 

@@ -73,6 +73,10 @@ LOCKS: tuple[LockDecl, ...] = (
              "AggregatorSink", "_dispatch_lock", 20,
              "serializes the donated device stream (ONE stream per "
              "table, however many store workers feed it)"),
+    LockDecl("ops.ecdsa_tables", "ct_mapreduce_tpu/ops/ecdsa.py",
+             None, "_TABLE_LOCK", 22,
+             "precompute-table build/LRU caches; the verify lane "
+             "builds under ingest.dispatch"),
     LockDecl("agg.save", "ct_mapreduce_tpu/agg/aggregator.py",
              "TpuAggregator", "_save_lock", 24,
              "whole-checkpoint writes (fleet cadence vs run's own save)"),

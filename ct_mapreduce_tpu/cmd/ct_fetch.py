@@ -115,6 +115,11 @@ def build_sink(config: CTConfig, database, backend=None):
                                                  or None),
                               verify_log_keys=(config.verify_log_keys
                                                or None),
+                              verify_precomp_window=(
+                                  config.verify_precomp_window
+                                  if config.verify_precomp_window >= 0
+                                  else None),
+                              verify_qtable_size=config.verify_qtable_size,
                               ), model
     sink = DatabaseSink(
         database,
@@ -364,6 +369,12 @@ def main(argv: list[str] | None = None) -> int:
         ovl = getattr(sink, "_overlap", None)
         if ovl is not None:
             body["overlap_queues"] = ovl.queue_depths()
+        verifier = getattr(sink, "verifier", None)
+        if verifier is not None:
+            # Round 17: verify-lane knobs, outcome totals, and Q-table
+            # occupancy (steady state: occupancy = live log keys,
+            # qtable_misses flat).
+            body["verify"] = verifier.health()
         if query_server is not None:
             body["serve"] = query_server.oracle.stats()
         if fleet is not None:

@@ -85,6 +85,12 @@ class CTConfig:
     verify_log_keys: str = ""  # JSON file of trusted log keys for the
     # verify lane (CTMR_VERIFY_KEYS equivalent; empty = no keys →
     # every SCT counts as verify.no_key)
+    verify_precomp_window: int = -1  # windowed-precompute ladder width
+    # in bits for the verify kernels (-1 = unset →
+    # CTMR_VERIFY_PRECOMP_WINDOW env, then 8; 0 is a REAL value — the
+    # legacy Jacobian ladder — so an explicit 0 beats a stray env)
+    verify_qtable_size: int = 0  # per-curve device-resident per-log-
+    # key Q-table LRU slots (0 = CTMR_VERIFY_QTABLE_SIZE env, then 32)
     num_workers: int = 0  # fleet size: logs partition across this many
     # ct-fetch workers by rendezvous hash (0 = CTMR_NUM_WORKERS env,
     # then 1 = single-worker)
@@ -151,6 +157,8 @@ class CTConfig:
         "serveCacheSize": ("serve_cache_size", int),
         "verifySignatures": ("verify_signatures", bool),
         "verifyLogKeys": ("verify_log_keys", str),
+        "verifyPrecompWindow": ("verify_precomp_window", int),
+        "verifyQTableSize": ("verify_qtable_size", int),
         "numWorkers": ("num_workers", int),
         "workerId": ("worker_id", int),
         "checkpointPeriod": ("checkpoint_period", str),
@@ -335,6 +343,16 @@ class CTConfig:
             "counts in reports and /issuer)",
             "verifyLogKeys = JSON file of trusted CT log keys for the "
             "verify lane (CTMR_VERIFY_KEYS equivalent)",
+            "verifyPrecompWindow = window width in bits for the "
+            "verify kernels' precomputed-table ladders "
+            "(CTMR_VERIFY_PRECOMP_WINDOW equivalent; default 8; an "
+            "explicit 0 pins the legacy per-bit Jacobian ladder even "
+            "when the env var is set)",
+            "verifyQTableSize = per-curve device-resident per-log-key "
+            "Q-table LRU slots for the windowed verify kernels "
+            "(CTMR_VERIFY_QTABLE_SIZE equivalent; default 32 — size "
+            "it at or above the live log-key count so steady state "
+            "is 100% verify.qtable_hits)",
             "numWorkers = ingest fleet size: CT logs partition across "
             "this many workers by rendezvous hash; a single-log fleet "
             "stripes the entry-index space (CTMR_NUM_WORKERS "

@@ -76,7 +76,7 @@ def resolve_staging(chunks_per_dispatch: int = 0,
     return max(1, k), max(1, d if d > 0 else 2)
 
 
-def _resolve_verify_lazy(flag, keys_path):
+def _resolve_verify_lazy(flag, keys_path, window=None, qtable_size=0):
     """Import-light wrapper around ``verify.lane.resolve_verify`` —
     the verify package (and with it the ECDSA kernels) only loads when
     the lane could actually be on."""
@@ -85,10 +85,11 @@ def _resolve_verify_lazy(flag, keys_path):
     if flag is None:
         flag = os.environ.get("CTMR_VERIFY", "0") == "1"
     if not flag:
-        return False, "", 0
+        return False, "", 0, 0, 0
     from ct_mapreduce_tpu.verify.lane import resolve_verify
 
-    return resolve_verify(True, keys_path)
+    return resolve_verify(True, keys_path, window=window,
+                          qtable_size=qtable_size)
 
 
 class EntrySink(Protocol):
@@ -174,7 +175,9 @@ class AggregatorSink:
                  decode_threads: int = 0, chunks_per_dispatch: int = 0,
                  staging_depth: int = 0,
                  verify_signatures: Optional[bool] = None,
-                 verify_log_keys: Optional[str] = None):
+                 verify_log_keys: Optional[str] = None,
+                 verify_precomp_window: Optional[int] = None,
+                 verify_qtable_size: int = 0):
         self.aggregator = aggregator
         self.flush_size = flush_size
         # Optional durable backend (certPath): first-seen certs get the
@@ -259,8 +262,12 @@ class AggregatorSink:
         # to verification. Verdicts fold into the aggregator's per-
         # issuer verified/failed vectors. Off by default: the lane adds
         # an extraction pass + a second kernel family to the hot path.
-        v_on, v_keys, v_batch = _resolve_verify_lazy(
-            verify_signatures, verify_log_keys)
+        # Round 17: `verifyPrecompWindow` (0 = legacy Jacobian ladder)
+        # selects the windowed-precompute kernels and `verifyQTableSize`
+        # bounds the per-curve device-resident per-log-key Q-table LRU.
+        v_on, v_keys, v_batch, v_window, v_qsize = _resolve_verify_lazy(
+            verify_signatures, verify_log_keys,
+            verify_precomp_window, verify_qtable_size)
         self.verifier = None
         if v_on:
             from ct_mapreduce_tpu.verify.lane import (
@@ -271,7 +278,8 @@ class AggregatorSink:
             keys = (LogKeyRegistry.from_json_file(v_keys) if v_keys
                     else LogKeyRegistry())
             self.verifier = SignatureVerifier(
-                aggregator, keys, batch_width=v_batch)
+                aggregator, keys, batch_width=v_batch,
+                window=v_window, qtable_size=v_qsize)
         self.overlap_workers = max(0, int(overlap_workers))
         self._overlap = None
         if self.overlap_workers:

@@ -1,25 +1,28 @@
-"""Batched 256-bit modular arithmetic on int32-limb lanes.
+"""Batched wide modular arithmetic on int32-limb lanes.
 
 The signature-verification lane (ops/ecdsa.py) needs field arithmetic
-over the P-256 prime and group order, vectorized over a batch axis the
-same way the SHA-256 kernel vectorizes lanes (ops/sha256.py): every
-lane is an independent big integer, all uint32 lane arithmetic, no
-cross-lane traffic — the shape the FPGA ECDSA engine (arxiv
+over the P-256/P-384 primes and group orders, vectorized over a batch
+axis the same way the SHA-256 kernel vectorizes lanes (ops/sha256.py):
+every lane is an independent big integer, all uint32 lane arithmetic,
+no cross-lane traffic — the shape the FPGA ECDSA engine (arxiv
 2112.02229) and zkSpeed's big-integer datapath (arxiv 2504.06211)
 exploit with wide limb lanes.
 
-Representation: a 256-bit value is ``uint32[..., 16]`` — sixteen
-16-bit limbs, little-endian. 16-bit limbs are the widest radix whose
-products and carry chains close over uint32 without 64-bit temporaries
-(accelerator int ops are 32-bit): a limb product is < 2^32, and the
-column accumulators below stay < 2^23.
+Representation: an n·16-bit value is ``uint32[..., n]`` — 16-bit
+limbs, little-endian (n = 16 for the 256-bit curves, 24 for P-384;
+the limb count is carried by the array shape and the :class:`Mod`
+constants, so every function below is width-generic). 16-bit limbs
+are the widest radix whose products and carry chains close over
+uint32 without 64-bit temporaries (accelerator int ops are 32-bit):
+a limb product is < 2^32, and the column accumulators below stay
+< 2^24 even at 24 limbs.
 
 Multiplication is Montgomery (REDC) with lazy column accumulation:
-the schoolbook product accumulates split lo/hi half-products into 33
-columns (each column sums ≤ 64 values < 2^16 — no overflow), then the
-reduction walks the 16 low limbs in a ``fori_loop``, deferring the
-m·N half-products into the same lazy columns, with one carry
-normalization at the end.
+the schoolbook product accumulates split lo/hi half-products into
+2n+1 columns (each column sums ≤ 2n+2 values < 2^16 — no overflow),
+then the reduction walks the n low limbs in a ``fori_loop``,
+deferring the m·N half-products into the same lazy columns, with one
+carry normalization at the end.
 
 Graph-size discipline: the ECDSA kernel runs ~20 of these per
 double-and-add step inside a 256-iteration ``fori_loop``, so the
@@ -30,10 +33,15 @@ traced iteration each) and the schoolbook columns are pad-and-add
 (flat, fusible) rather than scatter updates; a fully unrolled
 formulation compiled ~200 s on CPU, this one ~seconds.
 
-Moduli are host-side constants (:class:`Mod`); the two instances the
-verifier uses (P-256 field ``P256_P`` and order ``P256_N``) are built
-at import. All functions are shape-polymorphic over leading batch
-dims and jit-safe.
+Moduli are host-side constants (:class:`Mod`); the four instances the
+verifier uses (P-256/P-384 field and order) are built at import. All
+functions are shape-polymorphic over leading batch dims and jit-safe.
+
+Round 17 adds :func:`batch_inv_mont` — Montgomery batch inversion
+across the batch dimension (prefix-product scan → ONE Fermat
+inversion → suffix unwind), so a batch pays one inversion where the
+per-lane Fermat ladder paid ``16·n`` squarings+multiplies per lane —
+and :func:`window_digit` for the windowed-precompute ladders.
 """
 
 from __future__ import annotations
@@ -44,68 +52,85 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-NLIMB = 16  # 16 x 16-bit limbs = 256 bits
+NLIMB = 16  # 16 x 16-bit limbs = 256 bits (the P-256 width)
+NLIMB384 = 24  # 24 x 16-bit limbs = 384 bits (the P-384 width)
 RADIX = 16
 MASK = np.uint32(0xFFFF)
 
 
-def limbs_from_int(v: int) -> np.ndarray:
-    """Python int → uint32[16] little-endian 16-bit limbs."""
+def limbs_from_int(v: int, nlimb: int = NLIMB) -> np.ndarray:
+    """Python int → uint32[nlimb] little-endian 16-bit limbs."""
     return np.array(
-        [(v >> (RADIX * k)) & 0xFFFF for k in range(NLIMB)], np.uint32
+        [(v >> (RADIX * k)) & 0xFFFF for k in range(nlimb)], np.uint32
     )
 
 
 def int_from_limbs(a: np.ndarray) -> int:
-    """uint32[..., 16] limbs → python int (host-side, tests/debug)."""
+    """uint32[..., n] limbs → python int (host-side, tests/debug)."""
     a = np.asarray(a)
-    return sum(int(a[..., k]) << (RADIX * k) for k in range(NLIMB))
+    return sum(int(a[..., k]) << (RADIX * k)
+               for k in range(a.shape[-1]))
 
 
 @dataclass(frozen=True)
 class Mod:
     """One modulus' Montgomery constants (host numpy, baked at trace)."""
 
-    n: np.ndarray  # uint32[16] — the modulus
+    n: np.ndarray  # uint32[nlimb] — the modulus
     n0p: np.uint32  # -n^-1 mod 2^16 (REDC quotient multiplier)
-    r2: np.ndarray  # uint32[16] — R^2 mod n (R = 2^256): to-Montgomery
-    one: np.ndarray  # uint32[16] — plain 1 (from-Montgomery multiplier)
-    one_m: np.ndarray  # uint32[16] — R mod n (Montgomery 1)
-    exp_inv_bits: np.ndarray  # uint32[256] — bits of n-2, MSB first
-    # (Fermat inversion exponent; n must be prime)
+    r2: np.ndarray  # uint32[nlimb] — R^2 mod n (R = 2^(16·nlimb))
+    one: np.ndarray  # uint32[nlimb] — plain 1 (from-Montgomery mult)
+    one_m: np.ndarray  # uint32[nlimb] — R mod n (Montgomery 1)
+    exp_inv_bits: np.ndarray  # uint32[16·nlimb] — bits of n-2, MSB
+    # first (Fermat inversion exponent; n must be prime)
+
+    @property
+    def nlimb(self) -> int:
+        return int(self.n.shape[0])
 
     @classmethod
-    def make(cls, n_int: int) -> "Mod":
-        r = 1 << 256
+    def make(cls, n_int: int, nlimb: int = NLIMB) -> "Mod":
+        bits_total = RADIX * nlimb
+        r = 1 << bits_total
         n0p = (-pow(n_int, -1, 1 << RADIX)) % (1 << RADIX)
         e = n_int - 2
         bits = np.array(
-            [(e >> (255 - i)) & 1 for i in range(256)], np.uint32
+            [(e >> (bits_total - 1 - i)) & 1 for i in range(bits_total)],
+            np.uint32,
         )
         return cls(
-            n=limbs_from_int(n_int),
+            n=limbs_from_int(n_int, nlimb),
             n0p=np.uint32(n0p),
-            r2=limbs_from_int(r * r % n_int),
-            one=limbs_from_int(1),
-            one_m=limbs_from_int(r % n_int),
+            r2=limbs_from_int(r * r % n_int, nlimb),
+            one=limbs_from_int(1, nlimb),
+            one_m=limbs_from_int(r % n_int, nlimb),
             exp_inv_bits=bits,
         )
 
 
-# The two moduli of the P-256 verifier.
+# The field/order moduli of the P-256 and P-384 verifiers.
 P256_P_INT = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
 P256_N_INT = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+P384_P_INT = int(
+    "fffffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff"
+    "effffffff0000000000000000ffffffff", 16)
+P384_N_INT = int(
+    "ffffffffffffffffffffffffffffffffffffffffffffffffc7634d81f4372dd"
+    "f581a0db248b0a77aecec196accc52973", 16)
 
 P256_P = Mod.make(P256_P_INT)
 P256_N = Mod.make(P256_N_INT)
+P384_P = Mod.make(P384_P_INT, NLIMB384)
+P384_N = Mod.make(P384_N_INT, NLIMB384)
 
 
 def bytes_to_limbs(b):
-    """uint8[..., 32] big-endian bytes → uint32[..., 16] limbs."""
+    """uint8[..., 2n] big-endian bytes → uint32[..., n] limbs."""
     b = b.astype(jnp.uint32)
+    nl = b.shape[-1] // 2
     return jnp.stack(
-        [(b[..., 30 - 2 * k] << 8) | b[..., 31 - 2 * k]
-         for k in range(NLIMB)],
+        [(b[..., 2 * nl - 2 - 2 * k] << 8) | b[..., 2 * nl - 1 - 2 * k]
+         for k in range(nl)],
         axis=-1,
     )
 
@@ -174,14 +199,14 @@ def _cond_sub_n(a, carry, mod: Mod):
 
 def add_mod(a, b, mod: Mod):
     """(a + b) mod n for canonical a, b < n."""
-    s, c = _carry_norm(a + b, NLIMB)
+    s, c = _carry_norm(a + b, a.shape[-1])
     return _cond_sub_n(s, c, mod)
 
 
 def sub_mod(a, b, mod: Mod):
     """(a - b) mod n for canonical a, b < n."""
     d, borrow = sub_raw(a, b)
-    dn, _ = _carry_norm(d + jnp.asarray(mod.n), NLIMB)
+    dn, _ = _carry_norm(d + jnp.asarray(mod.n), a.shape[-1])
     return jnp.where((borrow != 0)[..., None], dn, d)
 
 
@@ -194,30 +219,31 @@ def mod_reduce_once(a, mod: Mod):
 
 
 def mont_mul(a, b, mod: Mod):
-    """Montgomery product a·b·R^-1 mod n (R = 2^256), canonical result.
+    """Montgomery product a·b·R^-1 mod n (R = 2^(16·nl)), canonical.
 
-    Preconditions: b < n; a < R (any 16-limb value — the to-Montgomery
-    conversion feeds raw 256-bit digests through here against r2 < n).
+    Preconditions: b < n; a < R (any nl-limb value — the to-Montgomery
+    conversion feeds raw digests through here against r2 < n).
 
-    Bound sketch: schoolbook columns take ≤ 16 lo + 16 hi terms
-    (< 2^21); REDC adds ≤ 1 lo + 1 hi per outer step (< 2^22 total);
-    the running REDC carry stays < 2^7 — everything closes over
-    uint32. The REDC output is < 2n, canonicalized by one conditional
-    subtract.
+    Bound sketch (nl ≤ 24): schoolbook columns take ≤ nl lo + nl hi
+    terms (< 2^22); REDC adds ≤ 1 lo + 1 hi per outer step (< 2^23
+    total); the running REDC carry stays < 2^8 — everything closes
+    over uint32. The REDC output is < 2n, canonicalized by one
+    conditional subtract.
     """
+    nl = int(mod.n.shape[0])  # static limb count from the modulus
     shape = a.shape[:-1]
     pads = [(0, 0)] * len(shape)
     # Schoolbook columns: outer product split into half-words, rows
     # shifted into place with static pads (flat, fusible — no scatter).
-    prod = a[..., :, None] * b[..., None, :]  # [..., 16, 16]
+    prod = a[..., :, None] * b[..., None, :]  # [..., nl, nl]
     lo = prod & MASK
     hi = prod >> RADIX
-    t = jnp.zeros(shape + (2 * NLIMB + 1,), jnp.uint32)
-    for i in range(NLIMB):
-        t = t + jnp.pad(lo[..., i, :], pads + [(i, NLIMB + 1 - i)])
-        t = t + jnp.pad(hi[..., i, :], pads + [(i + 1, NLIMB - i)])
+    t = jnp.zeros(shape + (2 * nl + 1,), jnp.uint32)
+    for i in range(nl):
+        t = t + jnp.pad(lo[..., i, :], pads + [(i, nl + 1 - i)])
+        t = t + jnp.pad(hi[..., i, :], pads + [(i + 1, nl - i)])
 
-    # REDC: finalize the 16 low limbs in order; position i's true low
+    # REDC: finalize the nl low limbs in order; position i's true low
     # 16 bits are known once the carry from position i-1 arrives, the
     # m·N half-products for higher positions stay lazy in the columns.
     n = jnp.asarray(mod.n)
@@ -228,21 +254,21 @@ def mont_mul(a, b, mod: Mod):
         ti = jax.lax.dynamic_index_in_dim(t, i, axis, keepdims=False)
         ti = ti + carry
         m = (ti * mod.n0p) & MASK
-        p = m[..., None] * n  # [..., 16]
+        p = m[..., None] * n  # [..., nl]
         x = ti + (p[..., 0] & MASK)  # ≡ 0 mod 2^16 by choice of m
-        # Deferred adds for positions i+1..i+16: element j of the
-        # window gains lo(p[j+1]) (j < 15) and hi(p[j]).
+        # Deferred adds for positions i+1..i+nl: element j of the
+        # window gains lo(p[j+1]) (j < nl-1) and hi(p[j]).
         upd = jnp.pad(p[..., 1:] & MASK, pads + [(0, 1)]) + (p >> RADIX)
-        win = jax.lax.dynamic_slice_in_dim(t, i + 1, NLIMB, axis)
+        win = jax.lax.dynamic_slice_in_dim(t, i + 1, nl, axis)
         t = jax.lax.dynamic_update_slice_in_dim(
             t, win + upd, i + 1, axis
         )
         return x >> RADIX, t
 
     carry, t = jax.lax.fori_loop(
-        0, NLIMB, body, (jnp.zeros(shape, jnp.uint32), t)
+        0, nl, body, (jnp.zeros(shape, jnp.uint32), t)
     )
-    res, c = _carry_norm(t[..., NLIMB:].at[..., 0].add(carry), NLIMB)
+    res, c = _carry_norm(t[..., nl:].at[..., 0].add(carry), nl)
     return _cond_sub_n(res, c, mod)
 
 
@@ -265,8 +291,8 @@ def mont_inv(a_m, mod: Mod):
 
     Square-and-multiply over the fixed exponent bits with a
     ``fori_loop`` (one squaring + one masked multiply per iteration),
-    so the traced graph is one step, not 256. a_m == 0 → 0 (the ECDSA
-    caller masks those lanes out via its own validity flags)."""
+    so the traced graph is one step, not 16·nl. a_m == 0 → 0 (the
+    ECDSA caller masks those lanes out via its own validity flags)."""
     bits = jnp.asarray(mod.exp_inv_bits)
     acc0 = jnp.broadcast_to(jnp.asarray(mod.one_m), a_m.shape)
 
@@ -275,7 +301,43 @@ def mont_inv(a_m, mod: Mod):
         mul = mont_mul(acc, a_m, mod)
         return jnp.where((bits[i] != 0)[..., None], mul, acc)
 
-    return jax.lax.fori_loop(0, 256, body, acc0)
+    return jax.lax.fori_loop(0, int(bits.shape[0]), body, acc0)
+
+
+def batch_inv_mont(a_m, mod: Mod):
+    """Montgomery batch inversion across the batch dimension.
+
+    ``a_m``: uint32[B, nl] canonical Montgomery-domain values. Returns
+    the per-lane Montgomery-domain inverses (bit-identical to
+    :func:`mont_inv` lane by lane — the inverse is unique) for ONE
+    Fermat inversion per batch: an exclusive prefix-product
+    ``lax.scan`` down the batch, one Fermat inversion of the total,
+    and a reverse-scan suffix unwind. Each scan step is a single-lane
+    mont_mul, so the whole thing costs ~3B narrow multiplies instead
+    of the ladder's 2·16·nl batch-wide ones.
+
+    Completeness: zero lanes are masked THROUGH the product — a zero
+    denominator is replaced by 1 before the prefix product and its
+    output is forced to 0 afterwards, so an adversarial lane (s = 0,
+    point-at-infinity Z = 0) can never poison a neighboring lane's
+    inverse. Matches mont_inv's 0 → 0 convention.
+    """
+    one_m = jnp.asarray(mod.one_m)
+    zero_lane = is_zero(a_m)  # [B]
+    safe = jnp.where(zero_lane[..., None], one_m[None, :], a_m)
+
+    def fwd(c, x):
+        return mont_mul(c, x, mod), c  # exclusive prefix product
+
+    total, pre = jax.lax.scan(fwd, one_m, safe)
+    tinv = mont_inv(total, mod)
+
+    def bwd(c, x_pre):
+        x, p = x_pre
+        return mont_mul(c, x, mod), mont_mul(c, p, mod)
+
+    _, inv = jax.lax.scan(bwd, tinv, (safe, pre), reverse=True)
+    return jnp.where(zero_lane[..., None], jnp.zeros_like(a_m), inv)
 
 
 def bit_at(a, k):
@@ -284,3 +346,15 @@ def bit_at(a, k):
         a, k >> 4, axis=a.ndim - 1, keepdims=False
     )
     return (limb >> (k & 15).astype(jnp.uint32)) & 1
+
+
+def window_digit(a, j, w: int):
+    """Window ``j``'s w-bit digit of a limb value: uint32[...] in
+    [0, 2^w). ``j`` is a traced scalar (the ladder's loop index); ``w``
+    is static and must divide 16 so a digit never straddles limbs."""
+    bit = j * w
+    limb = jax.lax.dynamic_index_in_dim(
+        a, bit >> 4, axis=a.ndim - 1, keepdims=False
+    )
+    return (limb >> (bit & 15).astype(jnp.uint32)) \
+        & jnp.uint32((1 << w) - 1)

@@ -1,32 +1,50 @@
-"""Batched ECDSA-P256 verification as a pure-JAX op.
+"""Batched ECDSA verification (P-256 + P-384) as pure-JAX ops.
 
-``verify_p256`` checks one signature per lane — digests, signature
-scalars and public keys as big-endian byte rows — entirely on device:
-scalar inversion by Fermat, Shamir's double-scalar multiplication
-u1·G + u2·Q in Jacobian coordinates over the Montgomery-domain field
-ops of :mod:`ct_mapreduce_tpu.ops.bigint`, and the r ≡ x_R (mod n)
-check. All uint32 lane arithmetic, vectorized over the batch axis like
-the SHA-256 kernel — the batched-limb shape of the FPGA ECDSA engine
-(arxiv 2112.02229).
+Two ladder formulations share one verdict contract — a lane's verdict
+is the mathematical ECDSA verdict, bit-identical to the pure-python
+reference verifier (:mod:`ct_mapreduce_tpu.verify.host`) on EVERY
+input, adversarial ones included:
 
-Verdict contract: a lane's verdict is the mathematical ECDSA verdict —
-bit-identical to the pure-python reference verifier
-(:mod:`ct_mapreduce_tpu.verify.host`) on EVERY input, adversarial ones
-included. Exceptional group-law cases (P = ±Q inside the ladder,
-points at infinity) are handled by explicit selects, not assumed away;
-invalid-range inputs (r/s ∉ [1, n-1], pubkey off-curve or out of
-range) fail closed. The kernel never *decides* which lanes it should
-see — routing (P-256 vs odd curves vs RSA) is the extractor's job,
-mirroring the walker-fallback pattern.
+- **Windowed precompute ladder (round 17, the default).** Both scalar
+  multiplications degenerate into table lookups: u1·G reads a
+  device-resident fixed-base window table (G never changes — built
+  once per process through the host reference, so the constants are
+  independently derivable), and u2·Q reads a per-key window table the
+  verify lane caches per log key (a CT workload verifies millions of
+  signatures under <100 distinct log keys — the opposite regime from
+  blockchain, so key-dependent precompute amortizes instantly). With
+  w-bit windows the whole dual-scalar multiplication is 2·(bits/w)
+  COMPLETE projective mixed additions (Renes–Costello–Batina 2015,
+  a = -3 — no exceptional cases, no doubling fallback branch) and
+  zero doublings. Inversions (s⁻¹ and the final x_R = X/Z
+  normalization) run through :func:`bigint.batch_inv_mont` — one
+  Fermat inversion per batch, zero denominators masked through the
+  product so adversarial lanes cannot desync a neighbor's verdict.
 
-The ladder is a ``fori_loop`` over the 256 scalar bits (one traced
-iteration, like ``preparsed_core``'s chunk loop), so batches compile
-once per width and per-lane cost amortizes the fixed per-op XLA
-dispatch overhead across the batch — the whole point of the wide lane
-formulation (tools/stagecost.py's ``verify`` stage records the curve).
+- **Jacobian Shamir ladder (window = 0, the round-13 formulation).**
+  Kept verbatim as the parity fallback: per-bit double + complete
+  mixed add, per-lane Fermat inversions. `verifyPrecompWindow = 0`
+  routes here; the KAT corpus pins windowed ≡ legacy ≡ host.
+
+Graph-size discipline is load-bearing either way: ladders are
+``fori_loop``s (one traced iteration), table lookups are gathers on a
+loop-indexed window slice, and batches compile once per
+(curve, window, width, table-slot) shape — pow2-padded so shapes stay
+log-bounded.
+
+The kernel never *decides* which lanes it should see — routing
+(P-256 vs P-384 vs odd curves vs RSA) is the extractor's and key
+registry's job, mirroring the walker-fallback pattern.
 """
 
 from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +54,9 @@ from ct_mapreduce_tpu.ops import bigint
 from ct_mapreduce_tpu.ops.bigint import (
     P256_N,
     P256_P,
+    P384_N,
+    P384_P,
+    Mod,
     add_mod,
     bytes_to_limbs,
     eq,
@@ -49,89 +70,136 @@ from ct_mapreduce_tpu.ops.bigint import (
     sub_mod,
     to_mont,
 )
+from ct_mapreduce_tpu.verify import host as vhost
 
-# Curve constants (b, G) as host limbs; Montgomery domain where used.
+# Historical P-256 constants (kept for reference/tests).
 P256_B_INT = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
 P256_GX_INT = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
 P256_GY_INT = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
 
-_R = 1 << 256
-_B_M = bigint.limbs_from_int(P256_B_INT * _R % bigint.P256_P_INT)
-_GX_M = bigint.limbs_from_int(P256_GX_INT * _R % bigint.P256_P_INT)
-_GY_M = bigint.limbs_from_int(P256_GY_INT * _R % bigint.P256_P_INT)
+DEFAULT_WINDOW = 8  # verifyPrecompWindow default; 0 = legacy ladder
+VALID_WINDOWS = (0, 2, 4, 8)  # w must divide 16 (limb radix)
+MIN_QTABLE_SLOTS = 32  # convenience-wrapper qtab slot floor — matches
+# the lane's default qtable size so tier-1 compiles ONE shape
 
 
-def _mulp(a, b):
-    return mont_mul(a, b, P256_P)
+@dataclass(frozen=True)
+class CurveOps:
+    """One curve's device-side constants (host numpy, baked at trace).
+    ``curve`` is the pure-python reference curve — table generation
+    runs through it so every precomputed point is independently
+    derivable from the reference implementation."""
+
+    name: str
+    curve: vhost.Curve
+    mod_p: Mod
+    mod_n: Mod
+    b_m: np.ndarray  # curve b, Montgomery domain
+    gx_m: np.ndarray  # generator, Montgomery domain
+    gy_m: np.ndarray
+    nbits: int
+    byte_len: int
 
 
-def _sqrp(a):
-    return mont_sqr(a, P256_P)
+def _make_ops(curve: vhost.Curve, mod_p: Mod, mod_n: Mod) -> CurveOps:
+    nl = mod_p.nlimb
+    r = 1 << (bigint.RADIX * nl)
+    p = curve.p
+    return CurveOps(
+        name=curve.name,
+        curve=curve,
+        mod_p=mod_p,
+        mod_n=mod_n,
+        b_m=bigint.limbs_from_int(curve.b * r % p, nl),
+        gx_m=bigint.limbs_from_int(curve.gx * r % p, nl),
+        gy_m=bigint.limbs_from_int(curve.gy * r % p, nl),
+        nbits=bigint.RADIX * nl,
+        byte_len=2 * nl,
+    )
 
 
-def _addp(a, b):
-    return add_mod(a, b, P256_P)
+P256_OPS = _make_ops(vhost.P256, P256_P, P256_N)
+P384_OPS = _make_ops(vhost.P384, P384_P, P384_N)
+CURVE_OPS = {o.name: o for o in (P256_OPS, P384_OPS)}
 
 
-def _subp(a, b):
-    return sub_mod(a, b, P256_P)
+def resolve_precomp_window(window: int | None = None) -> int:
+    """The ``verifyPrecompWindow`` knob: explicit value (directive /
+    kwarg, ≥ 0) > ``CTMR_VERIFY_PRECOMP_WINDOW`` env > default 8.
+    0 selects the legacy Jacobian ladder; invalid values (window must
+    divide 16) fall back to the default, matching the config layer's
+    tolerance for unparseable values."""
+    if window is None or window < 0:
+        try:
+            window = int(
+                os.environ.get("CTMR_VERIFY_PRECOMP_WINDOW", "") or -1)
+        except ValueError:
+            window = -1
+    if window < 0 or window not in VALID_WINDOWS:
+        return DEFAULT_WINDOW if window != 0 else 0
+    return window
 
 
-def _dbl(x1, y1, z1):
+# -- Jacobian ladder primitives (round 13, window = 0) -------------------
+
+def _dbl(x1, y1, z1, mod: Mod):
     """Jacobian doubling, a = -3 (dbl-2001-b). Z = 0 stays Z = 0, so
     infinity is preserved without a select."""
-    delta = _sqrp(z1)
-    gamma = _sqrp(y1)
-    beta = _mulp(x1, gamma)
-    t0 = _subp(x1, delta)
-    t1 = _addp(x1, delta)
-    alpha = _mulp(t0, t1)
-    alpha = _addp(_addp(alpha, alpha), alpha)  # 3·(x-δ)(x+δ)
-    b2 = _addp(beta, beta)
-    b4 = _addp(b2, b2)
-    b8 = _addp(b4, b4)
-    x3 = _subp(_sqrp(alpha), b8)
-    t2 = _addp(y1, z1)
-    z3 = _subp(_subp(_sqrp(t2), gamma), delta)
-    g2 = _sqrp(gamma)
-    g8 = _addp(_addp(g2, g2), _addp(g2, g2))
-    g8 = _addp(g8, g8)
-    y3 = _subp(_mulp(alpha, _subp(b4, x3)), g8)
+    delta = mont_sqr(z1, mod)
+    gamma = mont_sqr(y1, mod)
+    beta = mont_mul(x1, gamma, mod)
+    t0 = sub_mod(x1, delta, mod)
+    t1 = add_mod(x1, delta, mod)
+    alpha = mont_mul(t0, t1, mod)
+    alpha = add_mod(add_mod(alpha, alpha, mod), alpha, mod)
+    b2 = add_mod(beta, beta, mod)
+    b4 = add_mod(b2, b2, mod)
+    b8 = add_mod(b4, b4, mod)
+    x3 = sub_mod(mont_sqr(alpha, mod), b8, mod)
+    t2 = add_mod(y1, z1, mod)
+    z3 = sub_mod(sub_mod(mont_sqr(t2, mod), gamma, mod), delta, mod)
+    g2 = mont_sqr(gamma, mod)
+    g8 = add_mod(add_mod(g2, g2, mod), add_mod(g2, g2, mod), mod)
+    g8 = add_mod(g8, g8, mod)
+    y3 = sub_mod(mont_mul(alpha, sub_mod(b4, x3, mod), mod), g8, mod)
     return x3, y3, z3
 
 
 def _sel(c, a, b):
-    """Per-lane limb select: c bool[...], a/b uint32[..., 16]."""
+    """Per-lane limb select: c bool[...], a/b uint32[..., nl]."""
     return jnp.where(c[..., None], a, b)
 
 
-def _add_mixed(x1, y1, z1, x2, y2, q_inf):
-    """Complete Jacobian + affine addition.
+def _add_mixed(x1, y1, z1, x2, y2, q_inf, mod: Mod):
+    """Complete Jacobian + affine addition (the round-13 select-based
+    formulation — kept verbatim for the window = 0 parity path).
 
     Handles every exceptional case by select: P at infinity → Q,
     Q at infinity → P, P == Q → double, P == -Q → infinity. The
     general madd formulas are evaluated unconditionally (vector lanes
     are free); the selects pick the right answer per lane."""
     p_inf = is_zero(z1)
-    z1z1 = _sqrp(z1)
-    u2 = _mulp(x2, z1z1)
-    s2 = _mulp(y2, _mulp(z1, z1z1))
-    h = _subp(u2, x1)
-    rr = _subp(s2, y1)
-    hh = _sqrp(h)
-    hhh = _mulp(h, hh)
-    v = _mulp(x1, hh)
-    x3 = _subp(_subp(_sqrp(rr), hhh), _addp(v, v))
-    y3 = _subp(_mulp(rr, _subp(v, x3)), _mulp(y1, hhh))
-    z3 = _mulp(z1, h)
+    z1z1 = mont_sqr(z1, mod)
+    u2 = mont_mul(x2, z1z1, mod)
+    s2 = mont_mul(y2, mont_mul(z1, z1z1, mod), mod)
+    h = sub_mod(u2, x1, mod)
+    rr = sub_mod(s2, y1, mod)
+    hh = mont_sqr(h, mod)
+    hhh = mont_mul(h, hh, mod)
+    v = mont_mul(x1, hh, mod)
+    x3 = sub_mod(sub_mod(mont_sqr(rr, mod), hhh, mod),
+                 add_mod(v, v, mod), mod)
+    y3 = sub_mod(mont_mul(rr, sub_mod(v, x3, mod), mod),
+                 mont_mul(y1, hhh, mod), mod)
+    z3 = mont_mul(z1, h, mod)
 
     same_x = is_zero(h) & ~p_inf & ~q_inf
     dbl_case = same_x & is_zero(rr)
     neg_case = same_x & ~is_zero(rr)
-    dx, dy, dz = _dbl(x1, y1, z1)
+    dx, dy, dz = _dbl(x1, y1, z1, mod)
 
     zero = jnp.zeros_like(x1)
-    one_m = jnp.broadcast_to(jnp.asarray(P256_P.one_m), x1.shape)
+    one_m = jnp.broadcast_to(jnp.asarray(mod.one_m), x1.shape)
     x3 = _sel(dbl_case, dx, x3)
     y3 = _sel(dbl_case, dy, y3)
     z3 = _sel(dbl_case, dz, z3)
@@ -147,40 +215,39 @@ def _add_mixed(x1, y1, z1, x2, y2, q_inf):
     return x3, y3, z3
 
 
-def _to_affine(x, y, z):
+def _to_affine(x, y, z, mod: Mod):
     """Jacobian → affine (Montgomery domain); infinity → (0, 0, inf)."""
     inf = is_zero(z)
-    zi = mont_inv(z, P256_P)
-    zi2 = _sqrp(zi)
-    ax = _mulp(x, zi2)
-    ay = _mulp(y, _mulp(zi, zi2))
+    zi = mont_inv(z, mod)
+    zi2 = mont_sqr(zi, mod)
+    ax = mont_mul(x, zi2, mod)
+    ay = mont_mul(y, mont_mul(zi, zi2, mod), mod)
     return ax, ay, inf
 
 
-def _on_curve(x_m, y_m):
+def _on_curve(x_m, y_m, ops: CurveOps):
     """y² == x³ - 3x + b (Montgomery domain)."""
-    lhs = _sqrp(y_m)
-    x3 = _mulp(_sqrp(x_m), x_m)
-    x_3 = _addp(_addp(x_m, x_m), x_m)
-    rhs = _addp(_subp(x3, x_3),
-                jnp.broadcast_to(jnp.asarray(_B_M), x_m.shape))
+    mod = ops.mod_p
+    lhs = mont_sqr(y_m, mod)
+    x3 = mont_mul(mont_sqr(x_m, mod), x_m, mod)
+    x_3 = add_mod(add_mod(x_m, x_m, mod), x_m, mod)
+    rhs = add_mod(sub_mod(x3, x_3, mod),
+                  jnp.broadcast_to(jnp.asarray(ops.b_m), x_m.shape), mod)
     return eq(lhs, rhs)
 
 
-def verify_p256_core(digest, r, s, qx, qy, valid):
-    """Batched ECDSA-P256 verify over byte rows.
-
-    digest/r/s/qx/qy: uint8[B, 32] big-endian; valid: bool[B] (invalid
-    lanes short to False without influencing anything). → bool[B].
-    """
+def _check_inputs(ops: CurveOps, digest, r, s, qx, qy, valid):
+    """Shared validity prefix: limb conversion, range checks, on-curve
+    check, and the u1/u2 ingredients. Returns (ok, limbs...)."""
+    mod_n, mod_p = ops.mod_n, ops.mod_p
     r_l = bytes_to_limbs(r)
     s_l = bytes_to_limbs(s)
     e_l = bytes_to_limbs(digest)
     qx_l = bytes_to_limbs(qx)
     qy_l = bytes_to_limbs(qy)
 
-    n_b = jnp.broadcast_to(jnp.asarray(P256_N.n), r_l.shape)
-    p_b = jnp.broadcast_to(jnp.asarray(P256_P.n), r_l.shape)
+    n_b = jnp.broadcast_to(jnp.asarray(mod_n.n), r_l.shape)
+    p_b = jnp.broadcast_to(jnp.asarray(mod_p.n), r_l.shape)
     ok = (
         valid
         & ~is_zero(r_l) & ~geq(r_l, n_b)
@@ -188,58 +255,368 @@ def verify_p256_core(digest, r, s, qx, qy, valid):
         & ~geq(qx_l, p_b) & ~geq(qy_l, p_b)
         & ~(is_zero(qx_l) & is_zero(qy_l))
     )
-    qx_m = to_mont(qx_l, P256_P)
-    qy_m = to_mont(qy_l, P256_P)
-    ok = ok & _on_curve(qx_m, qy_m)
+    qx_m = to_mont(qx_l, mod_p)
+    qy_m = to_mont(qy_l, mod_p)
+    ok = ok & _on_curve(qx_m, qy_m, ops)
+    return ok, r_l, s_l, e_l, qx_m, qy_m
+
+
+def _scalars(ops: CurveOps, r_l, s_l, e_l, w_m):
+    """u1 = e·s⁻¹, u2 = r·s⁻¹ (plain domain) from the Montgomery-
+    domain inverse ``w_m``."""
+    mod_n = ops.mod_n
+    e_m = to_mont(mod_reduce_once(e_l, mod_n), mod_n)
+    r_nm = to_mont(mod_reduce_once(r_l, mod_n), mod_n)
+    u1 = from_mont(mont_mul(e_m, w_m, mod_n), mod_n)
+    u2 = from_mont(mont_mul(r_nm, w_m, mod_n), mod_n)
+    return u1, u2
+
+
+def _verify_jacobian(ops: CurveOps, digest, r, s, qx, qy, valid):
+    """The round-13 Shamir dual-scalar ladder, curve-parameterized.
+    Bit-identical to the original P-256 formulation (same ops, same
+    order) — the window = 0 parity fallback."""
+    mod_p = ops.mod_p
+    ok, r_l, s_l, e_l, qx_m, qy_m = _check_inputs(
+        ops, digest, r, s, qx, qy, valid)
 
     # Scalars: w = s^-1 mod n; u1 = e·w; u2 = r·w (plain domain).
     # A zero s would make the inversion garbage — ok lanes exclude it,
     # and garbage scalars on dead lanes can't resurrect the verdict.
-    s_m = to_mont(s_l, P256_N)
-    w_m = mont_inv(s_m, P256_N)
-    e_m = to_mont(mod_reduce_once(e_l, P256_N), P256_N)
-    r_nm = to_mont(mod_reduce_once(r_l, P256_N), P256_N)
-    u1 = from_mont(mont_mul(e_m, w_m, P256_N), P256_N)
-    u2 = from_mont(mont_mul(r_nm, w_m, P256_N), P256_N)
+    s_m = to_mont(s_l, ops.mod_n)
+    w_m = mont_inv(s_m, ops.mod_n)
+    u1, u2 = _scalars(ops, r_l, s_l, e_l, w_m)
 
     # Shamir precompute: T = G + Q (affine, per lane). Complete add
     # handles Q == ±G; T can be infinity (Q == -G).
-    gx_b = jnp.broadcast_to(jnp.asarray(_GX_M), qx_m.shape)
-    gy_b = jnp.broadcast_to(jnp.asarray(_GY_M), qy_m.shape)
-    one_m = jnp.broadcast_to(jnp.asarray(P256_P.one_m), qx_m.shape)
+    gx_b = jnp.broadcast_to(jnp.asarray(ops.gx_m), qx_m.shape)
+    gy_b = jnp.broadcast_to(jnp.asarray(ops.gy_m), qx_m.shape)
+    one_m = jnp.broadcast_to(jnp.asarray(mod_p.one_m), qx_m.shape)
     q_inf = jnp.zeros(ok.shape, bool)
-    tx_j, ty_j, tz_j = _add_mixed(gx_b, gy_b, one_m, qx_m, qy_m, q_inf)
-    tx, ty, t_inf = _to_affine(tx_j, ty_j, tz_j)
+    tx_j, ty_j, tz_j = _add_mixed(
+        gx_b, gy_b, one_m, qx_m, qy_m, q_inf, mod_p)
+    tx, ty, t_inf = _to_affine(tx_j, ty_j, tz_j, mod_p)
 
     # Joint double-and-add, MSB first: R = 2R; R += [G | Q | G+Q].
     zero = jnp.zeros_like(qx_m)
+    nbits = ops.nbits
 
     def body(i, carry):
         x, y, z = carry
-        k = 255 - i
+        k = nbits - 1 - i
         b1 = bigint.bit_at(u1, k)
         b2 = bigint.bit_at(u2, k)
         sel = b1 + 2 * b2  # 0:none 1:G 2:Q 3:G+Q
         ax = _sel(sel == 1, gx_b, _sel(sel == 2, qx_m, tx))
         ay = _sel(sel == 1, gy_b, _sel(sel == 2, qy_m, ty))
         a_inf = jnp.where(sel == 3, t_inf, sel == 0)
-        x, y, z = _dbl(x, y, z)
-        x, y, z = _add_mixed(x, y, z, ax, ay, a_inf)
+        x, y, z = _dbl(x, y, z, mod_p)
+        x, y, z = _add_mixed(x, y, z, ax, ay, a_inf, mod_p)
         return x, y, z
 
     rx, ry, rz = jax.lax.fori_loop(
-        0, 256, body, (zero, zero, jnp.zeros_like(qx_m))
+        0, nbits, body, (zero, zero, jnp.zeros_like(qx_m))
     )
 
     r_inf = is_zero(rz)
-    ax, _ay, _ = _to_affine(rx, ry, rz)
-    x_aff = from_mont(ax, P256_P)  # canonical x_R < p
-    # x_R mod n: p < 2n for P-256, one conditional subtract.
-    v = mod_reduce_once(x_aff, P256_N)
+    ax, _ay, _ = _to_affine(rx, ry, rz, mod_p)
+    x_aff = from_mont(ax, mod_p)  # canonical x_R < p
+    # x_R mod n: p < 2n for both NIST curves, one conditional subtract.
+    v = mod_reduce_once(x_aff, ops.mod_n)
     return ok & ~r_inf & eq(v, bytes_to_limbs(r))
 
 
+def verify_p256_core(digest, r, s, qx, qy, valid):
+    """Batched legacy ECDSA-P256 verify over byte rows.
+
+    digest/r/s/qx/qy: uint8[B, 32] big-endian; valid: bool[B] (invalid
+    lanes short to False without influencing anything). → bool[B].
+    """
+    return _verify_jacobian(P256_OPS, digest, r, s, qx, qy, valid)
+
+
 verify_p256_jit = jax.jit(verify_p256_core)
+
+_JACOBIAN_JITS: dict[str, object] = {"p256": verify_p256_jit}
+
+
+def jacobian_jit(ops: CurveOps):
+    """The jitted window = 0 ladder for ``ops`` (cached per curve)."""
+    f = _JACOBIAN_JITS.get(ops.name)
+    if f is None:
+        f = jax.jit(functools.partial(_verify_jacobian, ops))
+        _JACOBIAN_JITS[ops.name] = f
+    return f
+
+
+# -- complete projective addition (round 17) -----------------------------
+
+def _madd_complete(ops: CurveOps, x1, y1, z1, x2, y2):
+    """COMPLETE projective mixed addition, a = -3 (Renes–Costello–
+    Batina 2015, Alg. 5 — the formulas behind Go's crypto nistec
+    P-256). P1 = (X:Y:Z) homogeneous projective, ANY point including
+    the identity (0:1:0); P2 = (x2, y2) an affine curve point (never
+    the identity — callers select zero digits away). No exceptional
+    cases: P1 = ±P2 and P1 = ∞ all flow through the same 13
+    multiplies, which is what lets the windowed ladder drop the
+    per-add doubling fallback the Jacobian formulation pays."""
+    mod = ops.mod_p
+
+    def mul(a, b):
+        return mont_mul(a, b, mod)
+
+    def add(a, b):
+        return add_mod(a, b, mod)
+
+    def sub(a, b):
+        return sub_mod(a, b, mod)
+
+    b_c = jnp.broadcast_to(jnp.asarray(ops.b_m), x1.shape)
+    t0 = mul(x1, x2)
+    t1 = mul(y1, y2)
+    t3 = sub(sub(mul(add(x2, y2), add(x1, y1)), t0), t1)  # x1y2+x2y1
+    t4 = add(mul(y2, z1), y1)  # y1 + y2·z1
+    ty = add(mul(x2, z1), x1)  # x1 + x2·z1
+    bz = mul(b_c, z1)
+    x3 = sub(ty, bz)
+    x3 = add(x3, add(x3, x3))  # 3(ty - b·z1)
+    z3t = sub(t1, x3)
+    x3t = add(t1, x3)
+    y3 = mul(b_c, ty)
+    z1_3 = add(add(z1, z1), z1)
+    y3 = sub(sub(y3, z1_3), t0)
+    y3 = add(y3, add(y3, y3))  # 3(b·ty - 3z1 - t0)
+    t0n = sub(add(add(t0, t0), t0), z1_3)  # 3t0 - 3z1
+    xo = sub(mul(t3, x3t), mul(t4, y3))
+    yo = add(mul(x3t, z3t), mul(t0n, y3))
+    zo = add(mul(t4, z3t), mul(t3, t0n))
+    return xo, yo, zo
+
+
+# -- window tables (host-built through the reference curve math) ---------
+
+_TABLE_LOCK = threading.Lock()  # one precompute-table build at a time
+_GTABLES: dict[tuple[str, int], object] = {}  # (curve, w) → device tab
+_QTABLES: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+_QTABLE_NP_CAP = 128  # host-side np table LRU bound (per process)
+
+
+def _jac_add(p: int, P1, P2):
+    """Host Jacobian addition over python ints; Z = 0 is infinity.
+    General and total — doubling and cancellation resolve inline, so
+    degenerate (off-curve) bases still produce well-defined output."""
+    x1, y1, z1 = P1
+    x2, y2, z2 = P2
+    if z1 == 0:
+        return P2
+    if z2 == 0:
+        return P1
+    z1s = z1 * z1 % p
+    z2s = z2 * z2 % p
+    u1 = x1 * z2s % p
+    u2 = x2 * z1s % p
+    s1 = y1 * z2s * z2 % p
+    s2 = y2 * z1s * z1 % p
+    h = (u2 - u1) % p
+    r = (s2 - s1) % p
+    if h == 0:
+        if r != 0:
+            return (1, 1, 0)  # P = -Q
+        # P = Q: double (a = -3)
+        ys = y1 * y1 % p
+        s = 4 * x1 * ys % p
+        m = (3 * x1 * x1 - 3 * z1s * z1s) % p
+        x3 = (m * m - 2 * s) % p
+        return (x3, (m * (s - x3) - 8 * ys * ys) % p, 2 * y1 * z1 % p)
+    hs = h * h % p
+    hc = h * hs % p
+    v = u1 * hs % p
+    x3 = (r * r - hc - 2 * v) % p
+    return (x3, (r * (v - x3) - s1 * hc) % p, z1 * z2 * h % p)
+
+
+def _limbs_mont(v: int, nl: int) -> np.ndarray:
+    """int (already Montgomery-reduced) → uint32[nl] 16-bit limbs —
+    the builder's fast path (bytes view, no per-limb python loop)."""
+    return np.frombuffer(
+        v.to_bytes(2 * nl, "little"), "<u2").astype(np.uint32)
+
+
+def point_table_np(curve: vhost.Curve, x: int, y: int,
+                   window: int) -> np.ndarray:
+    """Fixed-base window table for base point (x, y): entry [j][d] is
+    the Montgomery-domain affine point d·2^(w·j)·(x, y), d ∈ [1, 2^w);
+    entry [j][0] is zeros (the identity — kernels select it away).
+
+    Built host-side from the reference curve constants with Jacobian
+    accumulation and ONE batched inversion for the whole table (the
+    same prefix-product→Fermat→unwind shape the device kernel uses),
+    so builds stay a fraction of a second per key. Independent
+    derivability is pinned by test: entries equal
+    ``verify/host._point_mul(curve, d << (w·j), (x, y))`` — the
+    pure-python reference scalar multiplication.
+
+    Invalid bases (off-curve registry keys, coordinates ≥ p) produce
+    well-defined garbage: the lanes that would read such a table
+    already failed the kernel's on-curve check, so the verdict is
+    False regardless of table contents — same fail-closed shape as
+    the round-13 kernel."""
+    nl = curve.byte_len // 2
+    nbits = bigint.RADIX * nl
+    nwin = nbits // window
+    r_mont = 1 << nbits
+    p = curve.p
+    tab = np.zeros((nwin, 1 << window, 2, nl), np.uint32)
+    base = (x % p, y % p, 1)
+    jac: list[tuple[int, tuple[int, int, int]]] = []  # (flat idx, point)
+    for j in range(nwin):
+        acc = (1, 1, 0)
+        for d in range(1, 1 << window):
+            acc = _jac_add(p, acc, base)
+            if acc[2] != 0:
+                jac.append((j * (1 << window) + d, acc))
+        for _ in range(window):
+            base = _jac_add(p, base, base)
+    # One inversion for every entry's Z: exclusive prefix products,
+    # one Fermat inversion of the total, reverse unwind.
+    prefix = []
+    total = 1
+    for _, (_x, _y, z) in jac:
+        prefix.append(total)
+        total = total * z % p
+    tinv = pow(total, p - 2, p)
+    for k in range(len(jac) - 1, -1, -1):
+        flat, (xj, yj, zj) = jac[k]
+        zi = tinv * prefix[k] % p
+        tinv = tinv * zj % p
+        zi2 = zi * zi % p
+        tab[flat >> window, flat & ((1 << window) - 1), 0] = \
+            _limbs_mont(xj * zi2 % p * r_mont % p, nl)
+        tab[flat >> window, flat & ((1 << window) - 1), 1] = \
+            _limbs_mont(yj * zi2 % p * zi % p * r_mont % p, nl)
+    return tab
+
+
+def fixed_base_table(ops: CurveOps, window: int):
+    """The shared device-resident u1·G table for (curve, window):
+    built once per process, then cached. Returns ``(table,
+    build_seconds)`` — build_seconds is 0.0 on a cache hit (callers
+    emit the verify.precomp_build_s metric only on real builds)."""
+    key = (ops.name, window)
+    with _TABLE_LOCK:
+        tab = _GTABLES.get(key)
+        if tab is not None:
+            return tab, 0.0
+        t0 = time.perf_counter()
+        np_tab = point_table_np(
+            ops.curve, ops.curve.gx, ops.curve.gy, window)
+        tab = jax.device_put(np_tab)
+        _GTABLES[key] = tab
+        return tab, time.perf_counter() - t0
+
+
+def point_table_cached(ops: CurveOps, window: int, x: int, y: int):
+    """Host-side np window table for an arbitrary base point,
+    LRU-cached per process and keyed by coordinates — two registries
+    (or registry epochs) that agree on a key's coordinates share the
+    build. Returns ``(np_table, build_seconds)``."""
+    key = (ops.name, window, x, y)
+    with _TABLE_LOCK:
+        tab = _QTABLES.get(key)
+        if tab is not None:
+            _QTABLES.move_to_end(key)
+            return tab, 0.0
+        t0 = time.perf_counter()
+        tab = point_table_np(ops.curve, x, y, window)
+        _QTABLES[key] = tab
+        while len(_QTABLES) > _QTABLE_NP_CAP:
+            _QTABLES.popitem(last=False)
+        return tab, time.perf_counter() - t0
+
+
+def zero_qtable(slots: int, nwin: int, entries: int, nl: int):
+    """Fresh device-resident Q-table slot array (all identity)."""
+    return jnp.zeros((slots, nwin, entries, 2, nl), jnp.uint32)
+
+
+qtable_slot_set = jax.jit(lambda tab, slot, val: tab.at[slot].set(val))
+"""Ship ONE key's window table into its LRU slot (slot is traced, so
+one compile serves every slot; only the new table crosses H2D)."""
+
+
+# -- windowed verification kernel ----------------------------------------
+
+def _verify_windowed(ops: CurveOps, digest, r, s, qx, qy, valid,
+                     key_idx, gtab, qtab):
+    """Batched windowed-precompute ECDSA verify.
+
+    digest/r/s/qx/qy: uint8[B, byte_len] big-endian (digest left-
+    padded for P-384); valid: bool[B]; key_idx: int32[B] slot of each
+    lane's Q table in ``qtab``; gtab: uint32[nwin, 2^w, 2, nl] (the
+    fixed-base G table); qtab: uint32[K, nwin, 2^w, 2, nl]. → bool[B].
+
+    The window size is static from gtab's shape, so one jit serves
+    every window at a given (width, K) — recompiles stay log-bounded.
+    """
+    mod_p, mod_n = ops.mod_p, ops.mod_n
+    ok, r_l, s_l, e_l, qx_m, qy_m = _check_inputs(
+        ops, digest, r, s, qx, qy, valid)
+
+    # s⁻¹ by batch inversion: ONE Fermat chain per batch; s = 0 lanes
+    # (already ok = False) are masked through the product.
+    s_m = to_mont(s_l, mod_n)
+    w_m = bigint.batch_inv_mont(s_m, mod_n)
+    u1, u2 = _scalars(ops, r_l, s_l, e_l, w_m)
+
+    nwin = int(gtab.shape[0])
+    w_bits = (int(gtab.shape[1]) - 1).bit_length()
+    zero = jnp.zeros_like(qx_m)
+    one_m = jnp.broadcast_to(jnp.asarray(mod_p.one_m), qx_m.shape)
+
+    def add_entry(carry, point, dig):
+        x, y, z = carry
+        px = point[..., 0, :]
+        py = point[..., 1, :]
+        xn, yn, zn = _madd_complete(ops, x, y, z, px, py)
+        keep = dig == 0  # digit 0 = identity: keep the accumulator
+        return (_sel(keep, x, xn), _sel(keep, y, yn),
+                _sel(keep, z, zn))
+
+    def body(j, carry):
+        d1 = bigint.window_digit(u1, j, w_bits)
+        d2 = bigint.window_digit(u2, j, w_bits)
+        g_j = jax.lax.dynamic_index_in_dim(
+            gtab, j, 0, keepdims=False)  # [2^w, 2, nl]
+        carry = add_entry(carry, jnp.take(g_j, d1, axis=0), d1)
+        q_j = jax.lax.dynamic_index_in_dim(
+            qtab, j, 1, keepdims=False)  # [K, 2^w, 2, nl]
+        carry = add_entry(carry, q_j[key_idx, d2], d2)
+        return carry
+
+    rx, _ry, rz = jax.lax.fori_loop(
+        0, nwin, body, (zero, one_m, jnp.zeros_like(qx_m))
+    )
+
+    r_inf = is_zero(rz)
+    # x_R = X/Z (homogeneous projective): one batched inversion, zero
+    # Z (infinity results) masked through the product.
+    z_inv = bigint.batch_inv_mont(rz, mod_p)
+    x_aff = from_mont(mont_mul(rx, z_inv, mod_p), mod_p)
+    v = mod_reduce_once(x_aff, mod_n)
+    return ok & ~r_inf & eq(v, r_l)
+
+
+_WINDOWED_JITS: dict[str, object] = {}
+
+
+def windowed_jit(ops: CurveOps):
+    """The jitted windowed kernel for ``ops`` (cached per curve; the
+    window/width/slot shapes specialize per call shape as usual)."""
+    f = _WINDOWED_JITS.get(ops.name)
+    if f is None:
+        f = jax.jit(functools.partial(_verify_windowed, ops))
+        _WINDOWED_JITS[ops.name] = f
+    return f
 
 
 def pad_width(n: int, min_width: int = 32) -> int:
@@ -248,36 +625,98 @@ def pad_width(n: int, min_width: int = 32) -> int:
     return max(min_width, 1 << max(0, (max(n, 1) - 1).bit_length()))
 
 
-def verify_p256(digest: np.ndarray, r: np.ndarray, s: np.ndarray,
-                qx: np.ndarray, qy: np.ndarray,
-                valid: np.ndarray | None = None) -> np.ndarray:
-    """Synchronous convenience wrapper: numpy byte rows in, bool[n]
-    out, padded to a pow2 width so compile shapes stay log-bounded.
-    The ingest lane uses :func:`verify_p256_submit` instead (async
-    dispatch, deferred readback)."""
-    out, n = verify_p256_submit(digest, r, s, qx, qy, valid)
+# -- numpy convenience wrappers ------------------------------------------
+
+def _pad_rows(a, width: int, byte_len: int):
+    a = np.ascontiguousarray(np.asarray(a, np.uint8))
+    if a.shape[1] < byte_len:  # left-pad short digests (P-384 lanes)
+        a = np.pad(a, ((0, 0), (byte_len - a.shape[1], 0)))
+    if a.shape[0] != width:
+        a = np.pad(a, ((0, width - a.shape[0]), (0, 0)))
+    return a
+
+
+def verify_batch(ops: CurveOps, digest, r, s, qx, qy,
+                 valid=None, window: int | None = None) -> np.ndarray:
+    """Synchronous convenience verify: numpy byte rows in (digest may
+    be shorter than byte_len — left-padded), bool[n] out. window
+    resolves via :func:`resolve_precomp_window`; window = 0 runs the
+    legacy Jacobian ladder. The windowed path groups lanes by unique
+    public key and builds/caches the per-key tables host-side — the
+    ingest lane keeps its own persistent device-resident cache
+    (verify/lane.py) instead of going through here."""
+    window = resolve_precomp_window(window)
+    n = int(digest.shape[0])
+    width = pad_width(n)
+    bl = ops.byte_len
+    v = (np.ones((n,), bool) if valid is None
+         else np.asarray(valid, bool))
+    v = np.pad(v, (0, width - n))
+    args = [_pad_rows(a, width, bl) for a in (digest, r, s, qx, qy)]
+    if window == 0:
+        out = jacobian_jit(ops)(*args, v)
+        return np.asarray(out)[:n]
+
+    gtab, _ = fixed_base_table(ops, window)
+    qx_p, qy_p = args[3], args[4]
+    slots: dict[tuple[int, int], int] = {}
+    key_idx = np.zeros((width,), np.int32)
+    tabs: list[np.ndarray] = []
+    c = ops.curve
+    for i in range(n):
+        kx = int.from_bytes(qx_p[i].tobytes(), "big")
+        ky = int.from_bytes(qy_p[i].tobytes(), "big")
+        # Lanes whose key fails the kernel's own range/on-curve checks
+        # are False regardless of ladder output — don't build tables
+        # for them (mutation-fuzz corpora are mostly such keys).
+        if not (kx < c.p and ky < c.p and (kx or ky)
+                and (ky * ky - kx * kx * kx - c.a * kx - c.b) % c.p
+                == 0):
+            continue
+        slot = slots.get((kx, ky))
+        if slot is None:
+            slot = len(tabs)
+            slots[(kx, ky)] = slot
+            tabs.append(point_table_cached(ops, window, kx, ky)[0])
+        key_idx[i] = slot
+    k_pad = max(MIN_QTABLE_SLOTS, pad_width(len(tabs), 1))
+    nl = ops.mod_p.nlimb
+    qtab = np.zeros((k_pad, ops.nbits // window, 1 << window, 2, nl),
+                    np.uint32)
+    if tabs:
+        qtab[: len(tabs)] = np.stack(tabs)
+    out = windowed_jit(ops)(*args, v, key_idx, gtab, qtab)
     return np.asarray(out)[:n]
 
 
+def verify_p256(digest: np.ndarray, r: np.ndarray, s: np.ndarray,
+                qx: np.ndarray, qy: np.ndarray,
+                valid: np.ndarray | None = None,
+                window: int | None = None) -> np.ndarray:
+    """Batched ECDSA-P256 verify over 32-byte rows → bool[n]."""
+    return verify_batch(P256_OPS, digest, r, s, qx, qy, valid, window)
+
+
+def verify_p384(digest: np.ndarray, r: np.ndarray, s: np.ndarray,
+                qx: np.ndarray, qy: np.ndarray,
+                valid: np.ndarray | None = None,
+                window: int | None = None) -> np.ndarray:
+    """Batched ECDSA-P384 verify over 48-byte rows (the 32-byte
+    SHA-256 digest is left-padded) → bool[n]."""
+    return verify_batch(P384_OPS, digest, r, s, qx, qy, valid, window)
+
+
 def verify_p256_submit(digest, r, s, qx, qy, valid=None):
-    """Dispatch the batched verify WITHOUT reading back: returns
+    """Legacy-ladder dispatch WITHOUT readback: returns
     ``(device_verdicts, n)`` — the caller slices ``[:n]`` after the
     (blocking) ``np.asarray``. JAX dispatch is asynchronous, so the
     device chews on the batch while the host stages the next one (the
     pipelining contract of the ingest sink's pendings)."""
     n = int(digest.shape[0])
     width = pad_width(n)
-
-    def prep(a):
-        a = np.ascontiguousarray(np.asarray(a, np.uint8))
-        if a.shape[0] != width:
-            a = np.pad(a, ((0, width - a.shape[0]), (0, 0)))
-        return a
-
     v = (np.ones((n,), bool) if valid is None
          else np.asarray(valid, bool))
     v = np.pad(v, (0, width - n))
     out = verify_p256_jit(
-        prep(digest), prep(r), prep(s), prep(qx), prep(qy), v
-    )
+        *[_pad_rows(a, width, 32) for a in (digest, r, s, qx, qy)], v)
     return out, n

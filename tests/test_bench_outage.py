@@ -70,10 +70,10 @@ def test_watchdog_mid_measurement_emits_partial_rate():
             "CT_BENCH_EXEC_SECS": "2",
             # Must fire AFTER >=1 timed chunk: the 16K-lane headline
             # compiles in ~8 s on this image and chunks take ~2 s, so
-            # 30 s leaves ~2.5x margin while keeping this (deliberate
-            # wait) test inside the tier-1 budget (round-14 trim;
-            # round 6 already took it 75 → 35).
-            "CT_BENCH_WATCHDOG_SECS": "30",
+            # 24 s leaves ~2x margin while keeping this (deliberate
+            # wait) test inside the tier-1 budget (round-17 trim;
+            # round 6 took it 75 → 35, round 14 → 30).
+            "CT_BENCH_WATCHDOG_SECS": "24",
         },
         timeout=300,
     )

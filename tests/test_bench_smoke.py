@@ -202,3 +202,8 @@ def test_bench_smoke_verify_gate():
     assert (out["smoke_verify_verified"] + out["smoke_verify_failed"]
             == out["smoke_verify_device_lanes"]
             + out["smoke_verify_fallback_lanes"])
+    # Round 17: the windowed precompute engaged — qtable hits beyond
+    # the one build per device log key, under the staged queue.
+    assert out["smoke_verify_window"] > 0
+    assert out["smoke_verify_qtable_misses"] == 2
+    assert out["smoke_verify_qtable_hits"] > 0

@@ -244,6 +244,31 @@ def test_verify_directives(tmp_path):
         assert d in usage
 
 
+def test_verify_precomp_directives(tmp_path):
+    """verifyPrecompWindow / verifyQTableSize (ISSUE 12): ini + env
+    layering, int parse, sentinel defaults (-1 window = unset, so an
+    explicit 0 — the legacy ladder — survives a stray env), usage().
+    The CTMR_VERIFY_PRECOMP_WINDOW / CTMR_VERIFY_QTABLE_SIZE env
+    equivalents layer downstream (verify.lane.resolve_verify, covered
+    by tests/test_verify_lane.py)."""
+    ini = tmp_path / "ct.ini"
+    ini.write_text("verifyPrecompWindow = 0\nverifyQTableSize = 48\n")
+    cfg = CTConfig.load(argv=["--config", str(ini)], env={})
+    assert cfg.verify_precomp_window == 0
+    assert cfg.verify_qtable_size == 48
+    cfg2 = CTConfig.load(
+        argv=["--config", str(ini)],
+        env={"verifyPrecompWindow": "4", "verifyQTableSize": "junk"})
+    assert cfg2.verify_precomp_window == 4
+    assert cfg2.verify_qtable_size == 48  # unparseable env ignored
+    dflt = CTConfig.load(argv=[], env={})
+    assert dflt.verify_precomp_window == -1  # unset sentinel
+    assert dflt.verify_qtable_size == 0
+    usage = CTConfig().usage()
+    for d in ("verifyPrecompWindow", "verifyQTableSize"):
+        assert d in usage
+
+
 def test_fleet_directives(tmp_path, monkeypatch):
     """numWorkers / workerId / checkpointPeriod / coordinatorBackend
     (ISSUE 9): ini + env layering, int parse, defaults, usage() — and

@@ -70,7 +70,16 @@ def test_checkpoint_layout_survives_env_change(monkeypatch, writer, reader):
         os.unlink(path)
 
 
-@pytest.mark.parametrize("layout", ["bucket", "open"])
+@pytest.mark.parametrize(
+    "layout",
+    ["bucket",
+     # @slow since round 17 (tier-1 budget banking, ISSUE 12): the
+     # re-hash-on-topology-mismatch contract is layout-independent
+     # code; tier-1 keeps the default bucket layout, and open-layout
+     # checkpoint/parity coverage stays tier-1 via test_layouts'
+     # other legs + test_staged_open_layout_parity. The open param
+     # re-runs the same contract at ~16 s in the full suite.
+     pytest.param("open", marks=pytest.mark.slow)])
 def test_checkpoint_topology_mismatch_rehashes(monkeypatch, layout):
     # A multi-shard writer records positions in shard-local addressing
     # (dest * nb_local + local hash); a single-chip reader must re-hash

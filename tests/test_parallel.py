@@ -126,7 +126,14 @@ def test_sharded_aggregator_matches_single_chip():
     assert snap_sh.total == snap_si.total == 8  # 2 issuers × 4 unique
 
 
+@pytest.mark.slow
 def test_sharded_aggregator_checkpoint_roundtrip(tmp_path):
+    """@slow since round 17 (tier-1 budget banking, ISSUE 12): the
+    sharded save → sharded load → dedup-after-restore contract is a
+    strict subset of tier-1
+    test_layouts::test_checkpoint_topology_mismatch_rehashes[bucket]
+    (sharded → single → sharded legs over the same mesh); this
+    same-topology re-run stays in the full suite."""
     from ct_mapreduce_tpu.agg.sharded_agg import ShardedAggregator
 
     entries = _entries(n_issuers=1)
@@ -277,11 +284,18 @@ def test_checkpoint_atomic_and_exact_path(tmp_path):
 
 
 @pytest.mark.timeout(580)
+@pytest.mark.slow
 def test_sixteen_device_virtual_mesh():
     """Scale the full multichip dryrun (binding dispatch caps,
     host-lane spills, exact totals, growth guard) to a 16-device
     virtual mesh — twice the width every other test uses. Subprocess:
-    the parent's jax is pinned to 8 devices."""
+    the parent's jax is pinned to 8 devices.
+
+    @slow since round 17 (tier-1 budget banking, ISSUE 12): every
+    dispatch/spill/growth invariant this checks is tier-1-gated on
+    the 8-device mesh (test_sharded.py, test_growth.py, the dryrun in
+    test_ingest_model_from_config); this leg re-runs the same code at
+    2x width in a ~25 s subprocess and stays in the full suite."""
     import subprocess
     import sys
     from pathlib import Path

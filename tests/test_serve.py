@@ -8,6 +8,7 @@ snapshot-consistent answers — every serial acked longer than the
 staleness bound before the query reads as known, and a serial never
 fed can never read known (ISSUE 5 acceptance)."""
 
+import contextlib
 import json
 import threading
 import time
@@ -403,17 +404,28 @@ def test_concurrent_ingest_query_consistency(template):
     w.join(timeout=120)
     for t in readers:
         t.join(timeout=30)
+    # Liveness: staggered refresh keeps landing — the pool advances
+    # through multiple epochs instead of serving one ancient view
+    # forever. How many land DURING the fixed-length load window is
+    # box-speed-dependent (compile-inflated captures on a loaded
+    # 1-core CI run can swallow most of it — observed round 17), so
+    # the check is a bounded WAIT for the third epoch, not a snapshot
+    # of whatever the window happened to reach: queries keep flowing
+    # until the refresh machinery proves it is still advancing.
+    deadline = time.time() + 60
+    while (oracle.snapshots.stats()["snapshot_epoch"] < 3
+           and time.time() < deadline):
+        with contextlib.suppress(Overloaded):
+            oracle.query_raw(
+                [(issuer_idx, eh, _serial_bytes(template, 0))])
+        time.sleep(0.05)
+    pool_stats = oracle.snapshots.stats()
     oracle.close()
     assert not errors, errors[:10]
     assert agg.metrics.get("overflow", 0) >= 0  # table survived
     # The run really exercised growth (the mid-grow torn-read hazard).
     assert agg.capacity > 1 << 10, "table never grew; raise n_batches"
-    # Liveness: staggered refresh kept landing — the pool advanced
-    # through multiple epochs under load instead of serving one
-    # ancient view forever (ages are compile-inflated on a cold CPU
-    # run, so the structural check is the robust one).
     assert fresh_ages, "no answers recorded"
-    pool_stats = oracle.snapshots.stats()
     assert pool_stats["snapshot_epoch"] >= 3, pool_stats
     assert pool_stats["replicas"] >= 2, pool_stats
     # And the final state is complete: every fed serial present.

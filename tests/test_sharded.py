@@ -24,7 +24,10 @@ def mesh8():
     return Mesh(devs, ("shard",))
 
 
-def packed_batch(entries, batch_size):
+def packed_batch(entries, batch_size=32):
+    # ONE 32-lane compile shape for every mesh-step test in this file
+    # (round-17 budget audit: 24- and 8-lane variants each paid their
+    # own ~10 s shard_map compile for width-independent assertions).
     b = packing.pack_entries(entries, batch_size=batch_size)
     return b.data, b.length, b.issuer_idx, b.valid
 
@@ -62,7 +65,7 @@ def test_sharded_within_batch_duplicates(certs):
     # Each cert appears twice in the same batch, on different lanes (and
     # usually different source devices): exactly one lane wins each.
     entries = [(c, 0) for c in certs[:12]] + [(c, 0) for c in certs[:12]]
-    data, length, issuer_idx, valid = packed_batch(entries, 24)
+    data, length, issuer_idx, valid = packed_batch(entries)
     out = sd.step(data, length, issuer_idx, valid, NOW_HOUR)
     wu = np.asarray(out.was_unknown)
     assert not np.asarray(out.host_lane).any()
@@ -75,7 +78,7 @@ def test_sharded_within_batch_duplicates(certs):
 def test_sharded_issuer_counts(certs):
     sd = ShardedDedup(mesh8(), capacity=1 << 13)
     entries = [(c, i % 4) for i, c in enumerate(certs)]
-    data, length, issuer_idx, valid = packed_batch(entries, 24)
+    data, length, issuer_idx, valid = packed_batch(entries)
     out = sd.step(data, length, issuer_idx, valid, NOW_HOUR)
     counts = np.asarray(out.issuer_unknown_counts)
     assert counts[:4].tolist() == [6, 6, 6, 6]
@@ -85,7 +88,7 @@ def test_sharded_issuer_counts(certs):
 def test_sharded_drain_meta(certs):
     sd = ShardedDedup(mesh8(), capacity=1 << 13)
     entries = [(c, 5) for c in certs[:8]]
-    data, length, issuer_idx, valid = packed_batch(entries, 8)
+    data, length, issuer_idx, valid = packed_batch(entries)
     sd.step(data, length, issuer_idx, valid, NOW_HOUR)
     keys, meta = sd.drain_np()
     assert keys.shape[0] == 8

@@ -50,7 +50,7 @@ def _corpus(n=24):
     p256, p384, rsa = _signers()
     unknown = sctlib.EcSctSigner("vl-unknown")
     pairs, expect = [], dict(verified=0, failed=0, no_sct=0, no_key=0,
-                             host=0, device=0)
+                             host=0, device=0, p384=0)
     for s in range(n):
         base = minicert.make_cert(
             serial=1000 + s, issuer_cn="Verify CA", subject_cn=f"l{s}",
@@ -66,9 +66,12 @@ def _corpus(n=24):
             expect["failed"] += 1
             expect["device"] += 1
         elif kind == 2:
+            # P-384 lanes ride the DEVICE since round 17 (re-extracted
+            # from row bytes, verified by the windowed P-384 kernel).
             der = sctlib.attach_sct(base, p384, 10**12 + s)
             expect["verified"] += 1
-            expect["host"] += 1
+            expect["device"] += 1
+            expect["p384"] += 1
         elif kind == 3:
             der = sctlib.attach_sct(base, rsa, 10**12 + s,
                                     corrupt_signature=True)
@@ -123,6 +126,11 @@ def _check_outcomes(agg, sink, expect, n_pairs):
     assert st["no_key"] == expect["no_key"]
     assert st["host_lanes"] == expect["host"]
     assert st["device_lanes"] == expect["device"]
+    assert st["p384_lanes"] == expect["p384"]
+    # Q-table accounting: one lookup per device lane, one miss per
+    # distinct (log key, registry epoch) — steady state is all hits.
+    assert st["qtable_hits"] + st["qtable_misses"] == expect["device"]
+    assert st["qtable_misses"] == 2  # one P-256 key + one P-384 key
     vc = agg.verify_counts()
     assert sum(v for v, _ in vc.values()) == expect["verified"]
     assert sum(f for _, f in vc.values()) == expect["failed"]
@@ -221,18 +229,29 @@ def test_storage_statistics_verify_totals(serial_run, tmp_path):
 
 
 def test_resolve_verify_env_layering(monkeypatch):
-    monkeypatch.delenv("CTMR_VERIFY", raising=False)
-    monkeypatch.delenv("CTMR_VERIFY_KEYS", raising=False)
-    monkeypatch.delenv("CTMR_VERIFY_BATCH", raising=False)
-    assert resolve_verify() == (False, "", 1024)
+    for var in ("CTMR_VERIFY", "CTMR_VERIFY_KEYS", "CTMR_VERIFY_BATCH",
+                "CTMR_VERIFY_PRECOMP_WINDOW", "CTMR_VERIFY_QTABLE_SIZE"):
+        monkeypatch.delenv(var, raising=False)
+    assert resolve_verify() == (False, "", 1024, 8, 32)
     monkeypatch.setenv("CTMR_VERIFY", "1")
     monkeypatch.setenv("CTMR_VERIFY_KEYS", "/tmp/k.json")
     monkeypatch.setenv("CTMR_VERIFY_BATCH", "256")
-    assert resolve_verify() == (True, "/tmp/k.json", 256)
+    monkeypatch.setenv("CTMR_VERIFY_PRECOMP_WINDOW", "4")
+    monkeypatch.setenv("CTMR_VERIFY_QTABLE_SIZE", "7")
+    assert resolve_verify() == (True, "/tmp/k.json", 256, 4, 7)
     # explicit beats env; junk batch env is ignored
     monkeypatch.setenv("CTMR_VERIFY_BATCH", "zap")
-    assert resolve_verify(False, "x.json", 64) == (False, "x.json", 64)
-    assert resolve_verify(True) == (True, "/tmp/k.json", 1024)
+    assert resolve_verify(False, "x.json", 64, 2, 3) \
+        == (False, "x.json", 64, 2, 3)
+    assert resolve_verify(True) == (True, "/tmp/k.json", 1024, 4, 7)
+    # explicit window 0 (the legacy ladder) beats a set env var —
+    # 0 is a REAL value, the parity fallback.
+    assert resolve_verify(True, window=0)[3] == 0
+    # invalid windows (must divide 16) fall back to the default 8.
+    monkeypatch.setenv("CTMR_VERIFY_PRECOMP_WINDOW", "5")
+    assert resolve_verify(True)[3] == 8
+    monkeypatch.setenv("CTMR_VERIFY_QTABLE_SIZE", "junk")
+    assert resolve_verify(True)[4] == 32
 
 
 def test_sink_loads_keys_from_file(tmp_path):
@@ -249,3 +268,124 @@ def test_sink_loads_keys_from_file(tmp_path):
     assert isinstance(sink.verifier, SignatureVerifier)
     assert len(sink.verifier.keys) == 3
     assert sink.verifier.keys.is_p256(p256.log_id)
+
+
+# -- round 17: Q-table cache, routing, legacy-window parity --------------
+
+def _sct_rows(certs):
+    pad = max(len(c) for c in certs) + 16
+    data = np.zeros((len(certs), pad), np.uint8)
+    length = np.zeros((len(certs),), np.int32)
+    for i, c in enumerate(certs):
+        data[i, : len(c)] = np.frombuffer(c, np.uint8)
+        length[i] = len(c)
+    return data, length
+
+
+def _submit(verifier, certs):
+    data, length = _sct_rows(certs)
+    scts = sctlib.extract_scts_np(data, length)
+    verifier.submit_chunk(
+        scts, np.zeros((len(certs),), np.int64),
+        np.ones((len(certs),), bool), data, length)
+
+
+def _sct_cert(signer, serial, ts=10**12):
+    base = minicert.make_cert(serial=serial, issuer_cn="QT CA",
+                              subject_cn=f"qt{serial}", is_ca=False,
+                              not_after=FUTURE)
+    return sctlib.attach_sct(base, signer, ts)
+
+
+def test_qtable_lru_eviction_and_epoch_invalidation():
+    """The per-log-key Q-table LRU: one miss per distinct (key,
+    registry epoch), hits afterwards, eviction under a 1-slot cap,
+    and re-registration (epoch bump) invalidating exactly that key.
+    Width 32 — the compile the parity suite already paid."""
+    ka, kb = sctlib.EcSctSigner("qt-a"), sctlib.EcSctSigner("qt-b")
+    ca, cb = _sct_cert(ka, 1), _sct_cert(kb, 2)
+
+    agg = TpuAggregator(capacity=1 << 12, batch_size=16)
+    tight = SignatureVerifier(agg, batch_width=32, qtable_size=1)
+    for s in (ka, kb):
+        tight.keys.register_signer(s)
+    _submit(tight, [ca, cb])
+    tight.drain()
+    st = tight.stats
+    assert (st["qtable_misses"], st["qtable_hits"]) == (2, 0)
+    _submit(tight, [ca])  # a was evicted by b under the 1-slot cap
+    tight.drain()
+    assert (st["qtable_misses"], st["qtable_hits"]) == (3, 0)
+    assert tight.health()["qtable"]["p256"]["occupancy"] == 1
+    assert st["verified"] == 3 and st["failed"] == 0
+
+    roomy = SignatureVerifier(agg, batch_width=32, qtable_size=4)
+    for s in (ka, kb):
+        roomy.keys.register_signer(s)
+    _submit(roomy, [ca, cb])
+    roomy.drain()
+    _submit(roomy, [ca, cb])  # steady state: 100% hits
+    roomy.drain()
+    st = roomy.stats
+    assert (st["qtable_misses"], st["qtable_hits"]) == (2, 2)
+    # Epoch bump: re-registering ka invalidates ONLY ka's slot.
+    roomy.keys.register_signer(ka)
+    _submit(roomy, [ca, cb])
+    roomy.drain()
+    assert (st["qtable_misses"], st["qtable_hits"]) == (3, 3)
+    h = roomy.health()
+    assert h["window"] == roomy.window > 0
+    assert h["qtable"]["p256"]["capacity"] == 4
+    assert h["qtable"]["p256"]["occupancy"] == 3  # stale ka slot + 2
+    assert h["stats"]["verified"] == st["verified"] == 6
+
+
+def test_p384_host_fallback_routing():
+    """The third routing leg: a lane keyed to a P-384 entry whose SCT
+    is NOT device-decidable (RSA algorithm bytes under the key's
+    log id) replays through the host verifier and fails closed —
+    P-256 device / P-384 device / host fallback all pinned."""
+    rsa = sctlib.RsaSctSigner()
+    cert = _sct_cert(rsa, 3)
+    p384k = sctlib.EcSctSigner("fb-384", host.P384)
+    agg = TpuAggregator(capacity=1 << 12, batch_size=16)
+    v = SignatureVerifier(agg, batch_width=32)
+    v.keys.register({
+        "log_id": rsa.log_id.hex(), "alg": "p384",
+        "x": hex(p384k.q[0]), "y": hex(p384k.q[1]),
+    })
+    _submit(v, [cert])
+    v.drain()
+    st = v.stats
+    assert st["host_lanes"] == 1 and st["device_lanes"] == 0
+    assert st["p384_lanes"] == 0
+    assert st["failed"] == 1 and st["verified"] == 0
+
+
+def test_lane_window0_legacy_parity():
+    """verifyPrecompWindow = 0 routes the lane down the round-13
+    Jacobian ladder (the parity fallback) — same outcomes as the
+    windowed default on the same lanes. P-256 only: the legacy P-384
+    compile is slow-tier (test_ecdsa), and the lane shares kernels
+    with it."""
+    ka = sctlib.EcSctSigner("w0-a")
+    certs = [_sct_cert(ka, 10), _sct_cert(ka, 11)]
+    bad = sctlib.attach_sct(
+        minicert.make_cert(serial=12, issuer_cn="QT CA",
+                           subject_cn="qt12", is_ca=False,
+                           not_after=FUTURE),
+        ka, 10**12, corrupt_signature=True)
+    certs.append(bad)
+
+    outcomes = []
+    for window in (0, None):
+        agg = TpuAggregator(capacity=1 << 12, batch_size=16)
+        v = SignatureVerifier(agg, batch_width=32, window=window)
+        v.keys.register_signer(ka)
+        _submit(v, certs)
+        v.drain()
+        outcomes.append((v.stats["verified"], v.stats["failed"],
+                         v.stats["device_lanes"]))
+    assert outcomes[0] == outcomes[1] == (2, 1, 3)
+    # window 0 builds no tables: the Q-table stats stay zero.
+    assert outcomes[0] == (2, 1, 3)

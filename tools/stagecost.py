@@ -409,72 +409,129 @@ def main() -> None:
                 f"({base[2] / best:.2f}x vs K=1, parity exact)")
 
     def run_verify():
-        """Device ns/signature vs batch width, host-parity asserted.
+        """Device ns/signature: precompute on/off × window-size curve
+        at each batch width, plus a P-384 leg — host-parity asserted
+        at EVERY (curve, window, width) point.
 
         Methodology matches the headline: jitted kernel, warmup run
-        (compile excluded), best-of-3 timed runs each ending in the
-        synchronous verdict readback. The corpus tiles 64 unique
-        signatures (3/4 valid, 1/4 mutated) so host-side generation
-        stays cheap at B=4096; parity is asserted lane-by-lane."""
+        (compile + table builds excluded), best-of-3 timed runs each
+        ending in the synchronous verdict readback. Window > 0 legs
+        measure the lane's steady state: G/Q tables device-resident
+        before the timed region (100% qtable hits — the production
+        regime under <100 log keys). The corpus tiles 64 unique
+        signatures under 7 distinct keys (3/4 valid, 1/4 mutated) so
+        host-side generation stays cheap at B=4096.
+
+        Env: CT_SC_VERIFY_B (widths, default 256,1024,4096),
+        CT_SC_VERIFY_W (windows, default 0,2,4,8; 0 = legacy ladder),
+        CT_SC_VERIFY_P384_B (P-384 widths, default 256; empty
+        disables), CT_SC_VERIFY_P384_W (default 0,8)."""
         import hashlib
+
+        import jax as _jax
 
         from ct_mapreduce_tpu.ops import ecdsa
         from ct_mapreduce_tpu.verify import host as vhost
 
+        def corpus(ops, n_uniq, n_keys):
+            c = ops.curve
+            nb = c.byte_len
+            uniq, key_xy = [], []
+            for i in range(n_uniq):
+                seed = f"sc-{c.name}-{i % n_keys}"
+                d = vhost.derive_scalar(seed, c)
+                q = vhost._point_mul(c, d, (c.gx, c.gy))
+                digest = hashlib.sha256(b"sc%d" % i).digest()
+                k = vhost.derive_nonce(seed, digest, c)
+                r, s_ = vhost.sign_ecdsa(c, digest, d, k)
+                if i % 4 == 0:
+                    s_ ^= 1 << (i % 250)  # mutated lane
+                uniq.append((digest, r, s_, q[0], q[1]))
+                if i < n_keys:
+                    key_xy.append(q)
+            href = [vhost.verify_ecdsa(c, dg, r, s_, x, y)
+                    for dg, r, s_, x, y in uniq]
+
+            def bn(v):
+                return np.frombuffer(
+                    (v % (1 << (8 * nb))).to_bytes(nb, "big"), np.uint8)
+
+            rows = {
+                "digest": np.stack([np.pad(
+                    np.frombuffer(u[0], np.uint8), (nb - 32, 0))
+                    for u in uniq]),
+                "r": np.stack([bn(u[1]) for u in uniq]),
+                "s": np.stack([bn(u[2]) for u in uniq]),
+                "qx": np.stack([bn(u[3]) for u in uniq]),
+                "qy": np.stack([bn(u[4]) for u in uniq]),
+            }
+            kidx = np.array([i % n_keys for i in range(n_uniq)],
+                            np.int32)
+            return rows, href, kidx, key_xy
+
+        def sweep(ops, widths, windows, n_uniq=64, n_keys=7):
+            rows, href, kidx, key_xy = corpus(ops, n_uniq, n_keys)
+            nl = ops.mod_p.nlimb
+            for w in widths:
+                reps = -(-w // n_uniq)
+                args = [np.tile(rows[k], (reps, 1))[:w]
+                        for k in ("digest", "r", "s", "qx", "qy")]
+                valid = np.ones((w,), bool)
+                key_idx = np.tile(kidx, reps)[:w]
+                expect = (href * reps)[:w]
+                base_ns = None
+                for win in windows:
+                    if win == 0:
+                        fn = ecdsa.jacobian_jit(ops)
+                        call = lambda: fn(*args, valid)  # noqa: E731
+                    else:
+                        t0 = time.perf_counter()
+                        gtab, _ = ecdsa.fixed_base_table(ops, win)
+                        slots = max(ecdsa.MIN_QTABLE_SLOTS, n_keys)
+                        qtab = np.zeros(
+                            (slots, ops.nbits // win, 1 << win, 2, nl),
+                            np.uint32)
+                        for ki, (x, y) in enumerate(key_xy):
+                            qtab[ki] = ecdsa.point_table_cached(
+                                ops, win, x, y)[0]
+                        qtab_dev = _jax.device_put(qtab)
+                        say(f"  verify {ops.name} B={w} w={win}: "
+                            f"tables {time.perf_counter() - t0:.1f}s")
+                        fn = ecdsa.windowed_jit(ops)
+                        call = lambda: fn(*args, valid, key_idx,  # noqa: E731,B023
+                                          gtab, qtab_dev)
+                    t0 = time.perf_counter()
+                    out = np.asarray(call())
+                    say(f"  verify {ops.name} B={w} w={win}: "
+                        f"compile+warmup {time.perf_counter() - t0:.1f}s")
+                    assert out.tolist() == expect, \
+                        f"verify {ops.name} B={w} w={win}: parity"
+                    best = None
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        out = np.asarray(call())
+                        dt = time.perf_counter() - t0
+                        best = dt if best is None else min(best, dt)
+                    assert out.tolist() == expect
+                    ns = best / w * 1e9
+                    if base_ns is None:
+                        base_ns = ns
+                    say(f"verify  {ops.name} B={w:<5d} w={win:<2d} "
+                        f"{best * 1e3:9.2f} ms/batch  {ns:12.1f} ns/sig"
+                        f"  ({base_ns / ns:.2f}x vs w={windows[0]}, "
+                        f"parity exact)")
+
         widths = [int(w) for w in os.environ.get(
-            "CT_SC_VERIFY_B", "256,1024,4096").split(",")]
-        c = vhost.P256
-        uniq = []
-        for i in range(64):
-            seed = f"sc-{i % 7}"
-            d = vhost.derive_scalar(seed)
-            q = vhost._point_mul(c, d, (c.gx, c.gy))
-            digest = hashlib.sha256(b"sc%d" % i).digest()
-            k = vhost.derive_nonce(seed, digest)
-            r, s_ = vhost.sign_ecdsa(c, digest, d, k)
-            if i % 4 == 0:
-                s_ ^= 1 << (i % 250)  # mutated lane
-            uniq.append((digest, r, s_, q[0], q[1]))
-        href = [vhost.verify_ecdsa(c, dg, r, s_, x, y)
-                for dg, r, s_, x, y in uniq]
-
-        def b32(v):
-            return np.frombuffer((v % (1 << 256)).to_bytes(32, "big"),
-                                 np.uint8)
-
-        rows = {
-            "digest": np.stack([np.frombuffer(u[0], np.uint8)
-                                for u in uniq]),
-            "r": np.stack([b32(u[1]) for u in uniq]),
-            "s": np.stack([b32(u[2]) for u in uniq]),
-            "qx": np.stack([b32(u[3]) for u in uniq]),
-            "qy": np.stack([b32(u[4]) for u in uniq]),
-        }
-        base_ns = None
-        for w in widths:
-            reps = -(-w // 64)
-            args = [np.tile(rows[k], (reps, 1))[:w]
-                    for k in ("digest", "r", "s", "qx", "qy")]
-            valid = np.ones((w,), bool)
-            t0 = time.perf_counter()
-            out = np.asarray(ecdsa.verify_p256_jit(*args, valid))
-            say(f"  verify B={w}: compile+warmup "
-                f"{time.perf_counter() - t0:.1f}s")
-            expect = (href * reps)[:w]
-            assert out.tolist() == expect, f"verify B={w}: parity"
-            best = None
-            for _ in range(3):
-                t0 = time.perf_counter()
-                out = np.asarray(ecdsa.verify_p256_jit(*args, valid))
-                dt = time.perf_counter() - t0
-                best = dt if best is None else min(best, dt)
-            assert out.tolist() == expect
-            ns = best / w * 1e9
-            if base_ns is None:
-                base_ns = ns
-            say(f"verify  B={w:<5d} {best * 1e3:9.2f} ms/batch  "
-                f"{ns:12.1f} ns/sig  ({base_ns / ns:.2f}x vs "
-                f"B={widths[0]}, parity exact)")
+            "CT_SC_VERIFY_B", "256,1024,4096").split(",") if w]
+        windows = [int(w) for w in os.environ.get(
+            "CT_SC_VERIFY_W", "0,2,4,8").split(",") if w != ""]
+        sweep(ecdsa.P256_OPS, widths, windows)
+        p384_b = [int(w) for w in os.environ.get(
+            "CT_SC_VERIFY_P384_B", "256").split(",") if w]
+        p384_w = [int(w) for w in os.environ.get(
+            "CT_SC_VERIFY_P384_W", "0,8").split(",") if w != ""]
+        if p384_b:
+            sweep(ecdsa.P384_OPS, p384_b, p384_w, n_uniq=16, n_keys=3)
 
     stages = [
         ("read", s_read), ("pack", s_pack), ("pack2", s_pack2),
