@@ -2055,6 +2055,85 @@ def run_filter_smoke() -> dict:
     }
 
 
+def run_distrib_smoke() -> dict:
+    """CT_BENCH_SMOKE distribution leg (round 18): a scaled-down
+    client pull storm against a W=2 serving fleet, CPU-only — the
+    tier-1 gate for ISSUE 13's acceptance:
+
+      (1) FLEET PARITY — both workers serve byte-identical full
+          artifacts AND byte-identical container encodings over HTTP
+          (tools/pullstorm.py raises before the storm otherwise);
+      (2) DELTA EXACTNESS — sampled delta pulls validate against the
+          chain manifest and replay to the exact full-artifact bytes
+          client-side (a mismatch fails the storm);
+      (3) TRAFFIC SHAPE — the storm's warm/lagging clients (delta +
+          304 traffic) move ≪ the bytes a full-pull fleet would
+          (gated at <20% of their counterfactual), 304s really
+          happen, and every pull class is exercised;
+      (4) the p99 and pulls/s are recorded for BENCHLOG (the 1-core
+          box number carries no scaling claim — the structure and
+          byte gates carry the leg).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tools import pullstorm
+
+    report = pullstorm.run_storm(
+        clients=600, epochs=4, groups=24, per_group=30, churn=2,
+        workers=2, threads=12, validate_every=10)
+    if report["worker_parity"] != 1:
+        raise BenchError("distrib smoke: worker parity not verified")
+    pulls = report["pulls"]
+    for kind in ("304", "delta", "full"):
+        if pulls.get(kind, {}).get("count", 0) <= 0:
+            raise BenchError(
+                f"distrib smoke: pull class {kind} never exercised "
+                f"({pulls})")
+    if report["ratio_304"] <= 0.1:
+        raise BenchError(
+            f"distrib smoke: 304 ratio {report['ratio_304']} — warm "
+            f"clients are not revalidating")
+    if report["delta_304_vs_full"] >= 0.20:
+        raise BenchError(
+            f"distrib smoke: delta+304 traffic is "
+            f"{report['delta_304_vs_full']:.2%} of the full-pull "
+            f"counterfactual — not ≪")
+    if report["p99_ms"] <= 0 or report["pulls_per_s"] <= 0:
+        raise BenchError("distrib smoke: latency/throughput not "
+                         "measured")
+    log(f"distrib smoke: {report['clients']} pulls over "
+        f"{report['workers']} workers -> "
+        f"{report['bytes_on_wire']} B on wire "
+        f"({report['wire_vs_counterfactual']:.1%} of full-pull), "
+        f"304 ratio {report['ratio_304']:.2f}, delta+304 at "
+        f"{report['delta_304_vs_full']:.1%} of counterfactual, "
+        f"p50 {report['p50_ms']}ms p99 {report['p99_ms']}ms, "
+        f"{report['pulls_per_s']}/s")
+    return {
+        "metric": "ct_distrib_smoke",
+        "value": report["pulls_per_s"],
+        "unit": "pulls/s",
+        "smoke_distrib_clients": report["clients"],
+        "smoke_distrib_workers": report["workers"],
+        "smoke_distrib_parity": report["worker_parity"],
+        "smoke_distrib_ratio_304": report["ratio_304"],
+        "smoke_distrib_wire_bytes": report["bytes_on_wire"],
+        "smoke_distrib_counterfactual_bytes":
+            report["counterfactual_full_bytes"],
+        "smoke_distrib_wire_vs_counterfactual":
+            report["wire_vs_counterfactual"],
+        "smoke_distrib_delta_304_vs_full": report["delta_304_vs_full"],
+        "smoke_distrib_full_artifact_bytes":
+            report["full_artifact_bytes"],
+        "smoke_distrib_p50_ms": report["p50_ms"],
+        "smoke_distrib_p99_ms": report["p99_ms"],
+        "smoke_distrib_pulls": {k: v["count"]
+                                for k, v in report["pulls"].items()},
+    }
+
+
 def run_fleet_smoke() -> dict:
     """CT_BENCH_SMOKE fleet leg (round 14): W ∈ {1, 2} local ct-fetch
     worker PROCESSES over a shared fakelog fixture, coordinated

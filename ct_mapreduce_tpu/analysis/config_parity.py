@@ -13,6 +13,14 @@ and the operator-facing MIGRATING.md. This rule diffs them:
   those are covered by reference docs) must appear in MIGRATING.md;
 - every ``CTMR_*`` env var consulted by a ``resolve_*`` function must
   appear in MIGRATING.md (the env layer is API).
+
+Round 18 (the platformProfile refactor) adds two surfaces: knob specs
+(``Knob(...)`` declarations in config/profile.py's engine) carry the
+env names that used to live inline in ``resolve_*`` bodies — their
+``CTMR_*`` strings are collected the same way — and every profile
+section resolved via ``resolve_section("<name>", ...)`` must be
+documented in MIGRATING.md as ``knobs.<name>`` (the profile file
+format is operator API too).
 """
 
 from __future__ import annotations
@@ -45,6 +53,8 @@ class ConfigParityChecker(Checker):
         super().__init__()
         # env var -> first "path:line" inside a resolve_* function
         self.resolve_envs: dict[str, str] = {}
+        # profile section -> first "path:line" of a resolve_section call
+        self.profile_sections: dict[str, str] = {}
         self._resolve_stack = 0
 
     # -- collect CTMR_* envs inside resolve_* functions ------------------
@@ -56,6 +66,26 @@ class ConfigParityChecker(Checker):
                     sub.value, str) and _ENV_RE.match(sub.value):
                 self.resolve_envs.setdefault(
                     sub.value, f"{ctx.module.relpath}:{sub.lineno}")
+
+    # -- collect CTMR_* envs from Knob specs + profile section names -----
+    def visit_Call(self, node: ast.Call, ctx: Ctx) -> None:
+        fn = node.func
+        name = (fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name == "Knob":
+            for arg in list(node.args) + [kw.value for kw in
+                                          node.keywords]:
+                if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str) and _ENV_RE.match(arg.value):
+                    self.resolve_envs.setdefault(
+                        arg.value, f"{ctx.module.relpath}:{arg.lineno}")
+        elif name == "resolve_section":
+            if node.args and isinstance(
+                    node.args[0], ast.Constant) and isinstance(
+                    node.args[0].value, str):
+                self.profile_sections.setdefault(
+                    node.args[0].value,
+                    f"{ctx.module.relpath}:{node.lineno}")
 
     # -- diff the four surfaces ------------------------------------------
     @staticmethod
@@ -132,3 +162,12 @@ class ConfigParityChecker(Checker):
                     f"migrating-env:{env}",
                     f"env var {env} (consulted by a resolve_* layer, "
                     f"{where}) undocumented in MIGRATING.md")
+        for section, where in sorted(self.profile_sections.items()):
+            if f"knobs.{section}" not in migrating:
+                self.report(
+                    where.rpartition(":")[0],
+                    int(where.rpartition(":")[2]),
+                    f"migrating-profile:{section}",
+                    f"platformProfile section knobs.{section} "
+                    f"(resolved at {where}) undocumented in "
+                    f"MIGRATING.md")

@@ -33,6 +33,13 @@ SCOPE_MODULES: tuple[str, ...] = (
     "ct_mapreduce_tpu/filter/artifact.py",
     "ct_mapreduce_tpu/filter/cascade.py",
     "ct_mapreduce_tpu/agg/merge.py",
+    # Distribution plane (round 18): delta and container bytes must be
+    # byte-identical on every worker of a fleet — their ETags ARE
+    # their SHA-256, so a nondeterministic byte breaks conditional
+    # GET fleet-wide. (distrib/publish.py is intentionally out of
+    # scope: Last-Modified wall stamps are header state, not bytes.)
+    "ct_mapreduce_tpu/distrib/delta.py",
+    "ct_mapreduce_tpu/distrib/container.py",
 )
 
 # (module pattern, function name): serialization paths inside

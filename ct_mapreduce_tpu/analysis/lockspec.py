@@ -135,6 +135,11 @@ LOCKS: tuple[LockDecl, ...] = (
              "queue-depth high-water marks"),
     LockDecl("serve.cache", "ct_mapreduce_tpu/serve/cache.py",
              "HotSerialCache", "_lock", 82, "hot-serial LRU"),
+    LockDecl("distrib.store", "ct_mapreduce_tpu/distrib/publish.py",
+             "FilterDistributor", "_lock", 83,
+             "published epochs + delta chain + compression cache "
+             "(checkpoint publishes vs HTTP reads; only telemetry "
+             "nests inside)"),
     LockDecl("native.build", "ct_mapreduce_tpu/native/__init__.py",
              None, "_LOCK", 84, "one native build at a time"),
     LockDecl("utils.miniredis", "ct_mapreduce_tpu/utils/miniredis.py",

@@ -179,6 +179,13 @@ def main(argv: list[str] | None = None) -> int:
         print("\nerror: logList is required", file=sys.stderr)
         return 2
 
+    # Platform profile (round 18): pin the tuned-knob data file before
+    # any subsystem resolves its knobs — every resolve_* from here on
+    # reads the profile layer (explicit > env > profile > default).
+    from ct_mapreduce_tpu.config import profile as platprofile
+
+    platprofile.set_active_profile(config.platform_profile)
+
     # Fleet resolution before any state path is used: each worker of a
     # multi-worker ingest keeps its own aggregate snapshot
     # (agg.npz → agg.w<id>.npz); storage-statistics merges them
@@ -298,6 +305,31 @@ def main(argv: list[str] | None = None) -> int:
             except Exception:
                 pass  # no capture yet / transient: tier stays as-is
 
+    def publish_distribution(epoch: int) -> None:
+        """Fleet-wide distribution (round 18): every epoch tick, THIS
+        worker publishes the artifact at the fleet's shared path —
+        the leader's merged fleet filter (written by
+        leader_fleet_filter just above in the leader's own tick) —
+        into its local distribution store. The bytes are
+        byte-identical on every worker by the determinism contract,
+        so every worker serves identical ETags/deltas/containers and
+        any replica is authoritative. Best-effort per tick: a
+        follower ticking before the leader's merged write lands
+        publishes one epoch behind and catches up next tick."""
+        if not emit_filter or query_server is None:
+            return
+        try:
+            with open(base_filter_path, "rb") as fh:
+                blob = fh.read()
+        except OSError:
+            return  # leader hasn't emitted yet; next epoch retries
+        try:
+            query_server.oracle.publish_artifact(
+                epoch, blob, source="fleet")
+        except Exception as err:
+            print(f"filter distribution publish failed: "
+                  f"{type(err).__name__}: {err}", file=sys.stderr)
+
     checkpoint_hook = None
     if model is not None and config.agg_state_path:
         # Snapshot device aggregates before every durable cursor write —
@@ -334,7 +366,8 @@ def main(argv: list[str] | None = None) -> int:
             checkpoint_period_s=(parse_duration(checkpoint_period)
                                  if checkpoint_period else 0.0),
             on_checkpoint=lambda epoch: (engine.checkpoint_now(),
-                                         leader_fleet_filter()),
+                                         leader_fleet_filter(),
+                                         publish_distribution(epoch)),
             on_shutdown=lambda reason: (
                 print(f"\nfleet shutdown broadcast: {reason}",
                       file=sys.stderr),
@@ -399,9 +432,13 @@ def main(argv: list[str] | None = None) -> int:
                 # tier and the /filter download routes (env
                 # CTMR_SERVE_FILTER_FIRST can still force either way).
                 filter_first=(True if emit_filter else None),
-                filter_fp_rate=filter_fp).start()
+                filter_fp_rate=filter_fp,
+                distrib_history=config.distrib_history,
+                max_delta_chain=config.max_delta_chain).start()
             print(f"query endpoint: :{query_server.port}/query "
-                  f"+ /issuer + /getcert + /filter", file=sys.stderr)
+                  f"+ /issuer + /getcert + /filter "
+                  f"(+ /filter/delta + /filter/container + "
+                  f"/filter/manifest)", file=sys.stderr)
         except OSError as err:
             print(f"query endpoint disabled: {err}", file=sys.stderr)
             query_server = None

@@ -1,13 +1,27 @@
 """ct-filter: build, inspect, and query revocation-filter artifacts
 offline from aggregate checkpoints — no running ct-fetch needed.
 
-The CLI face of :mod:`ct_mapreduce_tpu.filter` (round 15):
+The CLI face of :mod:`ct_mapreduce_tpu.filter` (round 15) and the
+distribution plane (round 18):
 
     ct-filter build -state agg.npz[,agg.w*.npz] -out run.filter \\
               [-fpRate 0.01] [-allowPartial]
     ct-filter inspect -artifact run.filter [-json]
     ct-filter query -artifact run.filter -issuer <issuerID> \\
               -expDate 2031-06-15-14 -serial 4d0000002a [-serial ...]
+    ct-filter delta -base e1.filter -target e2.filter -out e1-e2.delta \\
+              [-fromEpoch 1 -toEpoch 2]
+    ct-filter apply -base e1.filter -delta e1-e2.delta [-delta ...] \\
+              -out replayed.filter
+    ct-filter container -artifact run.filter -kind mlbf|clubcard \\
+              -out run.mlbf
+
+``delta`` computes the versioned ``CTMRDL01`` stash/diff between two
+epochs' artifacts; ``apply`` replays one or more delta links (bundles
+split automatically) and writes bytes guaranteed identical to the
+full build (the per-link SHA-256 checks fail loudly otherwise);
+``container`` re-encodes an artifact into an upstream
+clubcard/mlbf-style container (docs/FILTER_FORMAT.md).
 
 ``build`` folds one or many worker checkpoints (comma list and globs,
 the ``aggStatePath`` spelling) through the fleet merge
@@ -120,6 +134,64 @@ def _query(args, out) -> int:
     return 0 if all_known else 1
 
 
+def _delta(args, out) -> int:
+    from ct_mapreduce_tpu.distrib import compute_delta
+    from ct_mapreduce_tpu.filter import write_artifact
+
+    with open(args.base, "rb") as fh:
+        base = fh.read()
+    with open(args.target, "rb") as fh:
+        target = fh.read()
+    blob = compute_delta(base, target, args.fromEpoch, args.toEpoch)
+    write_artifact(args.out, blob)
+    print(json.dumps({
+        "out": args.out, "bytes": len(blob),
+        "fromEpoch": args.fromEpoch, "toEpoch": args.toEpoch,
+        "baseBytes": len(base), "targetBytes": len(target),
+        "ratio": round(len(blob) / max(1, len(target)), 4),
+    }, indent=2), file=out)
+    return 0
+
+
+def _apply(args, out) -> int:
+    from ct_mapreduce_tpu.distrib import (
+        DeltaError,
+        apply_chain,
+        split_bundle,
+    )
+    from ct_mapreduce_tpu.filter import write_artifact
+
+    with open(args.base, "rb") as fh:
+        blob = fh.read()
+    links = []
+    for path in args.delta:
+        with open(path, "rb") as fh:
+            links.extend(split_bundle(fh.read()))
+    try:
+        result = apply_chain(blob, links)
+    except DeltaError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    write_artifact(args.out, result)
+    print(json.dumps({"out": args.out, "bytes": len(result),
+                      "links": len(links)}, indent=2), file=out)
+    return 0
+
+
+def _container(args, out) -> int:
+    from ct_mapreduce_tpu.distrib import encode_container
+    from ct_mapreduce_tpu.filter import read_artifact, write_artifact
+
+    art = read_artifact(args.artifact)
+    blob = encode_container(art, args.kind)
+    write_artifact(args.out, blob)
+    print(json.dumps({
+        "out": args.out, "kind": args.kind, "bytes": len(blob),
+        "serials": art.n_serials, "groups": len(art.groups),
+    }, indent=2), file=out)
+    return 0
+
+
 def main(argv: list[str] | None = None, out=None) -> int:
     parser = argparse.ArgumentParser(prog="ct-filter")
     sub = parser.add_subparsers(dest="cmd")
@@ -150,6 +222,29 @@ def main(argv: list[str] | None = None, out=None) -> int:
     q.add_argument("-serial", "--serial", action="append", default=[],
                    help="serial content bytes as hex (repeatable)")
 
+    d = sub.add_parser("delta", help="CTMRDL01 diff between epochs")
+    d.add_argument("-base", "--base", required=True,
+                   help="the from-epoch full artifact")
+    d.add_argument("-target", "--target", required=True,
+                   help="the to-epoch full artifact")
+    d.add_argument("-out", "--out", required=True)
+    d.add_argument("-fromEpoch", "--fromEpoch", type=int, default=0)
+    d.add_argument("-toEpoch", "--toEpoch", type=int, default=1)
+
+    a = sub.add_parser("apply", help="replay delta link(s) onto a base")
+    a.add_argument("-base", "--base", required=True)
+    a.add_argument("-delta", "--delta", action="append", default=[],
+                   required=True,
+                   help="delta link or bundle (repeatable, in order)")
+    a.add_argument("-out", "--out", required=True)
+
+    c = sub.add_parser("container",
+                       help="re-encode as an upstream container")
+    c.add_argument("-artifact", "--artifact", required=True)
+    c.add_argument("-kind", "--kind", required=True,
+                   choices=("mlbf", "clubcard"))
+    c.add_argument("-out", "--out", required=True)
+
     args = parser.parse_args(argv)
     out = out or sys.stdout
     if args.cmd == "build":
@@ -167,6 +262,14 @@ def main(argv: list[str] | None = None, out=None) -> int:
             return 2
         try:
             return _query(args, out)
+        except (OSError, ValueError) as err:
+            print(f"error: {err}", file=sys.stderr)
+            return 2
+    if args.cmd in ("delta", "apply", "container"):
+        handler = {"delta": _delta, "apply": _apply,
+                   "container": _container}[args.cmd]
+        try:
+            return handler(args, out)
         except (OSError, ValueError) as err:
             print(f"error: {err}", file=sys.stderr)
             return 2

@@ -111,6 +111,12 @@ class CTConfig:
     # ("" = CTMR_FILTER_PATH env, then <aggStatePath>.filter)
     filter_fp_rate: float = 0.0  # target layer-0 false-positive rate
     # (0 = CTMR_FILTER_FP_RATE env, then 0.01)
+    platform_profile: str = ""  # tuned-knob profile JSON (one loader
+    # for every subsystem's resolve_*; "" = CTMR_PLATFORM_PROFILE env)
+    distrib_history: int = 0  # filter-distribution epochs held per
+    # worker (0 = CTMR_DISTRIB_HISTORY env, then 8)
+    max_delta_chain: int = 0  # delta links before a mandatory full-
+    # snapshot anchor (0 = CTMR_MAX_DELTA_CHAIN env, then 4)
     verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
@@ -166,6 +172,9 @@ class CTConfig:
         "emitFilter": ("emit_filter", bool),
         "filterPath": ("filter_path", str),
         "filterFpRate": ("filter_fp_rate", float),
+        "platformProfile": ("platform_profile", str),
+        "distribHistory": ("distrib_history", int),
+        "maxDeltaChain": ("max_delta_chain", int),
     }
 
     @classmethod
@@ -378,6 +387,18 @@ class CTConfig:
             "filterFpRate = target layer-0 false-positive rate of the "
             "filter cascade (CTMR_FILTER_FP_RATE equivalent; default "
             "0.01; included serials are exact regardless)",
+            "platformProfile = tuned-knob profile JSON file "
+            "(CTMR_PLATFORM_PROFILE equivalent): one loader feeds "
+            "every subsystem's knob resolution, so a tuned device "
+            "profile is a data file, not a code change — precedence "
+            "explicit directive > CTMR_* env > profile > default",
+            "distribHistory = filter-distribution epochs each worker "
+            "holds for delta/conditional-GET serving "
+            "(CTMR_DISTRIB_HISTORY equivalent; default 8)",
+            "maxDeltaChain = delta links between mandatory full-"
+            "snapshot anchors in the filter-distribution chain "
+            "(CTMR_MAX_DELTA_CHAIN equivalent; default 4 — bounds a "
+            "client's worst-case replay work)",
             "",
             "Diagnostics (env only):",
             "CTMR_LOCK_WITNESS=1 wraps every lock the package creates "

@@ -157,6 +157,122 @@ def classify_corpus(ders: list[bytes], pad_to: int = 1024,
     return report
 
 
+# -- grammar-aware structured mutators (ParsEval methodology) ------------
+#
+# Single-byte XOR fuzz mostly produces garbage both parsers reject in
+# the same place; the disagreement-inducing corpora of arxiv
+# 2405.18993 are STRUCTURALLY plausible — valid TLV trees with one
+# inconsistent length, or a nested element cut short while the outer
+# frames still claim the old size. These mutators operate on the
+# parsed TLV structure, not on random byte positions.
+
+
+def iter_tlvs(der: bytes, max_depth: int = 6) -> list[tuple]:
+    """Best-effort DER TLV walk: [(tag_off, len_off, header_len,
+    content_len, depth)] for every element reachable under well-formed
+    headers (single-byte tags; short / 0x81 / 0x82 length forms — the
+    forms the identity surface uses). Stops quietly at malformed
+    regions: mutants are produced FROM valid certs, so the walk sees
+    the full tree there."""
+    out: list[tuple] = []
+
+    def walk(off: int, end: int, depth: int) -> None:
+        while off + 2 <= end:
+            tag = der[off]
+            len_off = off + 1
+            first = der[len_off]
+            if first < 0x80:
+                hdr, clen = 2, first
+            elif first == 0x81 and len_off + 1 < end:
+                hdr, clen = 3, der[len_off + 1]
+            elif first == 0x82 and len_off + 2 < end:
+                hdr = 4
+                clen = (der[len_off + 1] << 8) | der[len_off + 2]
+            else:
+                return  # indefinite/absurd length form: stop here
+            if off + hdr + clen > end:
+                return
+            out.append((off, len_off, hdr, clen, depth))
+            constructed = bool(tag & 0x20)
+            if constructed and depth < max_depth and clen:
+                walk(off + hdr, off + hdr + clen, depth + 1)
+            off += hdr + clen
+
+    walk(0, len(der), 0)
+    return out
+
+
+def mutate_length_field(der: bytes, rng) -> bytes:
+    """Length-field surgery: pick one TLV and rewrite its length
+    encoding — off-by-one, a random value, or a long↔short form flip
+    (which inserts/removes a header byte WITHOUT fixing any outer
+    frame's length). The result is a tree whose frames disagree about
+    where elements end — the classic parser-divergence shape."""
+    tlvs = iter_tlvs(der)
+    if not tlvs:
+        return der
+    b = bytearray(der)
+    _, len_off, hdr, clen, _ = tlvs[int(rng.integers(len(tlvs)))]
+    mode = int(rng.integers(4))
+    if mode == 0:  # off-by-one (either direction)
+        delta = 1 if rng.integers(2) else -1
+        if hdr == 2:
+            b[len_off] = (b[len_off] + delta) % 0x80
+        elif hdr == 3:
+            b[len_off + 1] = (b[len_off + 1] + delta) % 256
+        else:
+            b[len_off + 2] = (b[len_off + 2] + delta) % 256
+    elif mode == 1:  # random length value, same form
+        if hdr == 2:
+            b[len_off] = int(rng.integers(0x80))
+        else:
+            b[len_off + hdr - 2] = int(rng.integers(256))
+    elif mode == 2 and hdr == 2:  # short -> long form 0x81 (inserts
+        # a byte; outer lengths now lie by one)
+        b[len_off:len_off + 1] = bytes([0x81, clen])
+    else:  # long -> shorter form (drops a byte), or minimal tweak
+        if hdr == 4:
+            b[len_off:len_off + 3] = bytes([0x81, min(clen, 255)])
+        elif hdr == 3:
+            b[len_off:len_off + 2] = bytes([clen & 0x7F])
+        else:
+            b[len_off] = (b[len_off] ^ 0x01) % 0x80
+    return bytes(b)
+
+
+def mutate_truncate_tlv(der: bytes, rng) -> bytes:
+    """Nested-TLV truncation/extension: splice bytes out of (or junk
+    into) one NESTED element's content while every enclosing frame
+    keeps its original length claim — the inner element is now too
+    short (or too long) for the tree around it."""
+    tlvs = [t for t in iter_tlvs(der) if t[4] >= 1 and t[3] > 0]
+    if not tlvs:
+        return der
+    off, _, hdr, clen, _ = tlvs[int(rng.integers(len(tlvs)))]
+    content = off + hdr
+    if rng.integers(2) or clen < 2:  # extend with junk bytes
+        k = int(rng.integers(1, 9))
+        junk = rng.integers(0, 256, k, dtype=np.uint8).tobytes()
+        cut = content + int(rng.integers(clen + 1))
+        return der[:cut] + junk + der[cut:]
+    # truncate: drop a tail slice of the content
+    k = int(rng.integers(1, max(2, clen // 2 + 1)))
+    return der[:content + clen - k] + der[content + clen:]
+
+
+def grammar_mutants(bases: list[bytes], rng, n: int) -> list[bytes]:
+    """``n`` structured mutants over ``bases``, half per mutator —
+    the corpus shape the standing ParsEval-style campaign feeds
+    through :func:`classify_corpus` + :func:`publish`."""
+    out = []
+    for i in range(n):
+        base = bases[int(rng.integers(len(bases)))]
+        mut = (mutate_length_field if i % 2 == 0
+               else mutate_truncate_tlv)
+        out.append(mut(base, rng))
+    return out
+
+
 def publish(report: DivergenceReport) -> None:
     """Emit the tracked metrics for one classified corpus. Counters
     accumulate across corpora; the accept-rate gauge reflects the

@@ -15,8 +15,7 @@ compiled from the aggregation state (ROADMAP item 5(b), round 15).
 
 from __future__ import annotations
 
-import os
-
+from ct_mapreduce_tpu.config import profile as platprofile
 from ct_mapreduce_tpu.filter.artifact import (  # noqa: F401
     DEFAULT_FP_RATE,
     FilterArtifact,
@@ -33,28 +32,36 @@ from ct_mapreduce_tpu.filter.cascade import (  # noqa: F401
 )
 
 
+_FILTER_KNOBS = (
+    platprofile.Knob("emitFilter", "CTMR_EMIT_FILTER", False,
+                     parse=platprofile.parse_bool_strict,
+                     env_is_set=platprofile.any_set, post=bool),
+    platprofile.Knob("filterPath", "CTMR_FILTER_PATH", "",
+                     parse=str, is_set=platprofile.nonempty_str),
+    platprofile.Knob("filterFpRate", "CTMR_FILTER_FP_RATE",
+                     DEFAULT_FP_RATE, parse=float,
+                     is_set=platprofile.pos_float,
+                     post=lambda v: float(v)),
+)
+
+
 def resolve_filter(emit=None, path: str = "", fp_rate: float = 0.0,
                    state_path: str = "") -> tuple[bool, str, float]:
-    """Resolve the filter-emission knobs: explicit value (config
+    """Resolve the filter-emission knobs through the shared
+    platformProfile ladder (config/profile.py): explicit value (config
     directive / kwarg) > ``CTMR_EMIT_FILTER`` / ``CTMR_FILTER_PATH`` /
-    ``CTMR_FILTER_FP_RATE`` env > defaults (off; ``<aggStatePath>
-    .filter``; 0.01 target FP rate). Unparseable env values are
-    ignored, matching the config layer's tolerance."""
-    if emit is None:
-        ev = os.environ.get("CTMR_EMIT_FILTER", "").strip().lower()
-        emit = ev in ("1", "t", "true")
-    p = path or os.environ.get("CTMR_FILTER_PATH", "")
+    ``CTMR_FILTER_FP_RATE`` env > profile ``knobs.filter`` > defaults
+    (off; ``<aggStatePath>.filter``; 0.01 target FP rate). Unparseable
+    env values are ignored, matching the config layer's tolerance."""
+    r = platprofile.resolve_section("filter", _FILTER_KNOBS, {
+        "emitFilter": emit,
+        "filterPath": path or "",
+        "filterFpRate": float(fp_rate or 0.0),
+    })
+    p = r["filterPath"]
     if not p and state_path:
         p = state_path + ".filter"
-    r = float(fp_rate or 0.0)
-    if r <= 0:
-        try:
-            r = float(os.environ.get("CTMR_FILTER_FP_RATE", "") or 0.0)
-        except ValueError:
-            r = 0.0
-    if r <= 0:
-        r = DEFAULT_FP_RATE
-    return bool(emit), p, r
+    return r["emitFilter"], p, r["filterFpRate"]
 
 
 __all__ = [

@@ -57,6 +57,7 @@ import time
 from datetime import datetime, timedelta, timezone
 from typing import Callable, Iterable, Optional, Protocol, Sequence
 
+from ct_mapreduce_tpu.config import profile as platprofile
 from ct_mapreduce_tpu.telemetry import metrics
 
 # Cache key namespaces (alongside the reference's leader-/started-).
@@ -141,35 +142,42 @@ def worker_state_path(path: str, worker_id: int, num_workers: int) -> str:
     return f"{root}.w{worker_id}{ext}"
 
 
+_FLEET_KNOBS = (
+    platprofile.Knob("numWorkers", "CTMR_NUM_WORKERS", 1,
+                     parse=int, is_set=platprofile.pos_int,
+                     post=lambda v: max(1, int(v))),
+    # -1 = unset; 0 is a REAL id (the one id every fleet must have
+    # exactly once), so a config that pins workerId = 0 must beat a
+    # stray env value.
+    platprofile.Knob("workerId", "CTMR_WORKER_ID", 0,
+                     parse=int, is_set=platprofile.nonneg_int,
+                     post=lambda v: max(0, int(v))),
+    platprofile.Knob("checkpointPeriod", "CTMR_CHECKPOINT_PERIOD", "",
+                     parse=str, is_set=platprofile.nonempty_str),
+    platprofile.Knob("coordinatorBackend", "CTMR_COORDINATOR", "",
+                     parse=str, is_set=platprofile.nonempty_str),
+)
+
+
 def resolve_fleet(num_workers: int = 0, worker_id: int = -1,
                   checkpoint_period: str = "",
                   backend: str = "") -> tuple[int, int, str, str]:
-    """Resolve the fleet knobs: explicit value (config directive) >
+    """Resolve the fleet knobs through the shared platformProfile
+    ladder (config/profile.py): explicit value (config directive) >
     ``CTMR_NUM_WORKERS`` / ``CTMR_WORKER_ID`` /
-    ``CTMR_CHECKPOINT_PERIOD`` / ``CTMR_COORDINATOR`` env > defaults
-    (1 worker, id 0, no checkpoint cadence, auto backend).
-    ``worker_id`` uses -1 as its unset sentinel: 0 is a real id (the
-    one id every fleet must have exactly once), so a config that pins
-    ``workerId = 0`` must beat a stray env value. Unparseable env
-    values are ignored, matching the config layer's tolerance."""
-
-    def env_int(name: str) -> Optional[int]:
-        raw = os.environ.get(name, "")
-        try:
-            return int(raw) if raw else None
-        except ValueError:
-            return None
-
-    n = int(num_workers or 0)
-    if n <= 0:
-        n = env_int("CTMR_NUM_WORKERS") or 1
-    wid = int(worker_id)
-    if wid < 0:
-        wid = env_int("CTMR_WORKER_ID") or 0
-    period = checkpoint_period or os.environ.get(
-        "CTMR_CHECKPOINT_PERIOD", "")
-    be = backend or os.environ.get("CTMR_COORDINATOR", "")
-    return max(1, n), max(0, wid), period, be
+    ``CTMR_CHECKPOINT_PERIOD`` / ``CTMR_COORDINATOR`` env > profile
+    ``knobs.fleet`` > defaults (1 worker, id 0, no checkpoint cadence,
+    auto backend). ``worker_id`` uses -1 as its unset sentinel.
+    Unparseable env values are ignored, matching the config layer's
+    tolerance."""
+    r = platprofile.resolve_section("fleet", _FLEET_KNOBS, {
+        "numWorkers": int(num_workers or 0),
+        "workerId": int(worker_id),
+        "checkpointPeriod": checkpoint_period,
+        "coordinatorBackend": backend,
+    })
+    return (r["numWorkers"], r["workerId"], r["checkpointPeriod"],
+            r["coordinatorBackend"])
 
 
 # -- the coordinator protocol -------------------------------------------

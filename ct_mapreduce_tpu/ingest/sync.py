@@ -47,33 +47,35 @@ from ct_mapreduce_tpu.ingest.leaf import (
     decode_json_entry,
     leaf_timestamp_ms as decode_leaf_timestamp,
 )
+from ct_mapreduce_tpu.config import profile as platprofile
 from ct_mapreduce_tpu.telemetry import metrics, trace
 
 ENTRY_QUEUE_CAPACITY = 16384  # ct-fetch.go:132
 
+_STAGING_KNOBS = (
+    platprofile.Knob("chunksPerDispatch", "CTMR_CHUNKS_PER_DISPATCH", 1,
+                     parse=int, is_set=platprofile.pos_int,
+                     post=lambda v: max(1, int(v))),
+    platprofile.Knob("stagingDepth", "CTMR_STAGING_DEPTH", 2,
+                     parse=int, is_set=platprofile.pos_int,
+                     post=lambda v: max(1, int(v))),
+)
+
 
 def resolve_staging(chunks_per_dispatch: int = 0,
                     staging_depth: int = 0) -> tuple[int, int]:
-    """Resolve the staged-device-queue knobs: explicit value (config
+    """Resolve the staged-device-queue knobs through the shared
+    platformProfile ladder (config/profile.py): explicit value (config
     directive / kwarg) > ``CTMR_CHUNKS_PER_DISPATCH`` /
-    ``CTMR_STAGING_DEPTH`` env > defaults (K=1 — legacy per-chunk
-    dispatch; depth 2 — double buffer). Unparseable env values are
-    ignored, matching the config layer's tolerance."""
-    import os
-
-    def env_int(name: str) -> int:
-        try:
-            return int(os.environ.get(name, "0") or 0)
-        except ValueError:
-            return 0
-
-    k = int(chunks_per_dispatch or 0)
-    if k <= 0:
-        k = env_int("CTMR_CHUNKS_PER_DISPATCH")
-    d = int(staging_depth or 0)
-    if d <= 0:
-        d = env_int("CTMR_STAGING_DEPTH")
-    return max(1, k), max(1, d if d > 0 else 2)
+    ``CTMR_STAGING_DEPTH`` env > profile ``knobs.staging`` > defaults
+    (K=1 — legacy per-chunk dispatch; depth 2 — double buffer).
+    Unparseable env values are ignored, matching the config layer's
+    tolerance."""
+    r = platprofile.resolve_section("staging", _STAGING_KNOBS, {
+        "chunksPerDispatch": int(chunks_per_dispatch or 0),
+        "stagingDepth": int(staging_depth or 0),
+    })
+    return r["chunksPerDispatch"], r["stagingDepth"]
 
 
 def _resolve_verify_lazy(flag, keys_path, window=None, qtable_size=0):

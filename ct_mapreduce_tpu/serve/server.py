@@ -45,6 +45,7 @@ from typing import Optional
 
 import numpy as np
 
+from ct_mapreduce_tpu.config import profile as platprofile
 from ct_mapreduce_tpu.core.types import ExpDate
 from ct_mapreduce_tpu.serve.batcher import (
     DeadlineExceeded,
@@ -57,31 +58,35 @@ from ct_mapreduce_tpu.telemetry import trace
 from ct_mapreduce_tpu.telemetry.metrics import incr_counter
 
 
+_SERVE_KNOBS = (
+    platprofile.Knob("serveReplicas", "CTMR_SERVE_REPLICAS", 2,
+                     parse=int, is_set=platprofile.pos_int,
+                     post=lambda v: int(v)),
+    platprofile.Knob("serveDevice", "CTMR_SERVE_DEVICE", True,
+                     parse=platprofile.parse_bool_lenient,
+                     env_is_set=platprofile.any_set, post=bool),
+    platprofile.Knob("serveCacheSize", "CTMR_SERVE_CACHE_SIZE", 4096,
+                     parse=int, is_set=platprofile.nonzero_int,
+                     post=lambda v: max(0, int(v))),
+)
+
+
 def resolve_serve(replicas: int = 0, device: Optional[bool] = None,
                   cache_size: int = 0) -> tuple[int, bool, int]:
-    """Resolve the serving-tier knobs: explicit value (config directive
-    / kwarg) > ``CTMR_SERVE_REPLICAS`` / ``CTMR_SERVE_DEVICE`` /
-    ``CTMR_SERVE_CACHE_SIZE`` env > defaults (2 replicas; device
-    serving with automatic host fallback; 4096-entry hot-serial
-    cache). ``cache_size < 0`` disables the cache; unparseable env
-    values are ignored, matching the config layer's tolerance."""
-
-    def env_int(name: str) -> int:
-        try:
-            return int(os.environ.get(name, "") or 0)
-        except ValueError:
-            return 0
-
-    r = int(replicas or 0)
-    if r <= 0:
-        r = env_int("CTMR_SERVE_REPLICAS") or 2
-    if device is None:
-        ev = os.environ.get("CTMR_SERVE_DEVICE", "").strip().lower()
-        device = ev not in ("0", "f", "false") if ev else True
-    c = int(cache_size or 0)
-    if c == 0:
-        c = env_int("CTMR_SERVE_CACHE_SIZE") or 4096
-    return r, bool(device), max(0, c)
+    """Resolve the serving-tier knobs through the shared
+    platformProfile ladder (config/profile.py): explicit value (config
+    directive / kwarg) > ``CTMR_SERVE_REPLICAS`` /
+    ``CTMR_SERVE_DEVICE`` / ``CTMR_SERVE_CACHE_SIZE`` env > profile
+    ``knobs.serve`` > defaults (2 replicas; device serving with
+    automatic host fallback; 4096-entry hot-serial cache).
+    ``cache_size < 0`` disables the cache; unparseable env values are
+    ignored, matching the config layer's tolerance."""
+    r = platprofile.resolve_section("serve", _SERVE_KNOBS, {
+        "serveReplicas": int(replicas or 0),
+        "serveDevice": device,
+        "serveCacheSize": int(cache_size or 0),
+    })
+    return (r["serveReplicas"], r["serveDevice"], r["serveCacheSize"])
 
 
 def resolve_filter_first(flag=None) -> bool:
@@ -175,6 +180,8 @@ class MembershipOracle:
         cache_size: int = 0,
         filter_first: Optional[bool] = None,
         filter_fp_rate: float = 0.0,
+        distrib_history: int = 0,
+        max_delta_chain: int = 0,
     ) -> None:
         self._agg = agg
         replicas, device, cache_size = resolve_serve(
@@ -196,6 +203,20 @@ class MembershipOracle:
         self.filter_first = resolve_filter_first(filter_first)
         self.filter_fp_rate = float(filter_fp_rate) or DEFAULT_FP_RATE
         self.filter_tier: Optional[FilterTier] = None
+        # Distribution store (round 18): published epochs, delta
+        # links, containers, pre-compressed variants — what the
+        # /filter* CDN routes serve. Armed alongside the filter tier.
+        self.distributor = None
+        if self.filter_first:
+            from ct_mapreduce_tpu.distrib import (
+                FilterDistributor,
+                resolve_distrib,
+            )
+
+            history, max_chain = resolve_distrib(
+                distrib_history, max_delta_chain)
+            self.distributor = FilterDistributor(
+                history=history, max_chain=max_chain)
         if self.filter_first and getattr(
                 agg, "filter_capture", None) is not None:
             try:
@@ -206,13 +227,29 @@ class MembershipOracle:
     def refresh_filter(self, fp_rate: float = 0.0) -> FilterTier:
         """(Re)build the filter tier from the live aggregator's
         capture, tagged with the replica pool's current floor epoch.
-        Raises ``ValueError`` when the aggregator has no capture."""
+        The rebuilt artifact also publishes into the distribution
+        store (source ``local`` — a leader-fed merged artifact
+        outranks it). Raises ``ValueError`` when the aggregator has no
+        capture."""
         tier = FilterTier.build(
             self._agg, float(fp_rate) or self.filter_fp_rate,
             self.snapshots.floor_epoch())
         self.filter_tier = tier
+        if self.distributor is not None:
+            self.distributor.publish(
+                tier.epoch, tier.artifact.to_bytes(), source="local")
         incr_counter("serve", "filter_refresh")
         return tier
+
+    def publish_artifact(self, epoch: int, blob: bytes,
+                         source: str = "fleet") -> bool:
+        """Publish externally built artifact bytes (the fleet leader's
+        merged filter, fanned out on epoch ticks) into this worker's
+        distribution store. Byte-identical input on every worker ⇒
+        identical ETags/deltas/containers fleet-wide."""
+        if self.distributor is None:
+            return False
+        return self.distributor.publish(epoch, blob, source=source)
 
     def _run_batch(self, items: list) -> list:
         view = self.snapshots.view()
@@ -312,6 +349,8 @@ class MembershipOracle:
             body["filter_epoch"] = self.filter_tier.epoch
             body["filter_staleness_s"] = round(self.filter_tier.age_s(), 6)
             body["filter_serials"] = self.filter_tier.artifact.n_serials
+        if self.distributor is not None:
+            body.update(self.distributor.stats())
         return body
 
     def close(self) -> None:
@@ -359,7 +398,9 @@ class QueryServer:
                  device: Optional[bool] = None, replicas: int = 0,
                  cache_size: int = 0, transport=None,
                  filter_first: Optional[bool] = None,
-                 filter_fp_rate: float = 0.0) -> None:
+                 filter_fp_rate: float = 0.0,
+                 distrib_history: int = 0,
+                 max_delta_chain: int = 0) -> None:
         self.host = host
         self.port = int(port)
         self.oracle = MembershipOracle(
@@ -367,7 +408,9 @@ class QueryServer:
             max_queue_lanes=max_queue_lanes,
             max_staleness_s=max_staleness_s, device=device,
             replicas=replicas, cache_size=cache_size,
-            filter_first=filter_first, filter_fp_rate=filter_fp_rate)
+            filter_first=filter_first, filter_fp_rate=filter_fp_rate,
+            distrib_history=distrib_history,
+            max_delta_chain=max_delta_chain)
         self._transport = transport
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -413,27 +456,145 @@ class QueryServer:
             return 404, {"error": "unknown issuer", "issuer": issuer_id}
         return 200, meta
 
-    def handle_filter(self, rest: str):
-        """``GET /filter`` → the whole artifact; ``GET
-        /filter/<issuer>/<expDate>`` → a standalone single-group
-        artifact (byte format of docs/FILTER_FORMAT.md either way).
-        404 when the tier is cold or the group is unknown; the body is
-        the binary blob a crlite-style consumer feeds to ``ct-filter
-        query``."""
+    # Cache policies per distribution resource: "latest"-shaped
+    # resources move every epoch, epoch-pinned resources never change.
+    _CC_LATEST = "public, max-age=60, must-revalidate"
+    _CC_IMMUTABLE = "public, max-age=31536000, immutable"
+
+    def _blob_response(self, blob: bytes, etag: str, req_headers,
+                       cache_control: str, cache_key=None,
+                       created_wall: Optional[float] = None,
+                       epoch: Optional[int] = None):
+        """One distribution payload: strong-ETag conditional GET
+        (If-None-Match ⇒ 304 with zero body bytes), Accept-Encoding
+        negotiation against the pre-compressed cache, and per-artifact
+        cache headers."""
+        from email.utils import formatdate
+
+        headers = {"ETag": etag, "Cache-Control": cache_control,
+                   "Vary": "Accept-Encoding"}
+        if created_wall is not None:
+            headers["Last-Modified"] = formatdate(created_wall,
+                                                  usegmt=True)
+        if epoch is not None:
+            headers["X-Filter-Epoch"] = str(epoch)
+        inm = (req_headers.get("If-None-Match", "")
+               if req_headers else "")
+        if inm and (inm.strip() == "*"
+                    or etag in [t.strip() for t in inm.split(",")]):
+            incr_counter("distrib", "http_304")
+            return 304, b"", headers
+        distributor = self.oracle.distributor
+        if req_headers is not None and distributor is not None:
+            from ct_mapreduce_tpu.distrib import negotiate_encoding
+
+            enc = negotiate_encoding(
+                req_headers.get("Accept-Encoding", ""))
+            if enc:
+                payload = distributor.encoded(cache_key, blob, enc)
+                headers["Content-Encoding"] = enc
+                incr_counter("distrib", "bytes_sent",
+                             value=float(len(payload)))
+                return 200, payload, headers
+        incr_counter("distrib", "bytes_sent", value=float(len(blob)))
+        return 200, blob, headers
+
+    def handle_filter(self, rest: str, req_headers=None):
+        """The distribution surface (docs/FILTER_FORMAT.md formats):
+
+        - ``GET /filter`` — the latest full ``CTMRFL01`` artifact;
+        - ``GET /filter/manifest`` — the chain manifest JSON (latest
+          epoch + hash, delta links with per-link SHA-256, anchors);
+        - ``GET /filter/container/<kind>`` — the latest artifact in an
+          upstream container encoding (``mlbf`` | ``clubcard``);
+        - ``GET /filter/delta/<from>/<to>`` — the concatenated
+          ``CTMRDL01`` links replaying epoch *from* to *to* (404 ⇒
+          no contiguous chain: full-pull);
+        - ``GET /filter/<issuer>/<expDate>`` — a standalone
+          single-group artifact slice.
+
+        Every binary answer carries a strong ETag (SHA-256 of the
+        deterministic bytes — identical on every worker of a fleet),
+        honors ``If-None-Match`` with 304, negotiates
+        gzip/zstd via ``Accept-Encoding``, and sets per-artifact
+        ``Cache-Control``/``Last-Modified``. 404 when the tier is cold
+        or the resource is unknown."""
         tier = self.oracle.filter_tier
-        if tier is None:
+        distributor = self.oracle.distributor
+        latest = distributor.latest() if distributor is not None else None
+        if tier is None and latest is None:
             return 404, {"error": "filter tier not armed "
                                   "(emitFilter / refresh_filter)"}
-        if not rest:
-            return 200, tier.artifact.to_bytes()
-        parts = rest.split("/")
+        parts = [p for p in rest.split("/") if p] if rest else []
+        if not parts:
+            incr_counter("distrib", "http_full")
+            if latest is not None:
+                return self._blob_response(
+                    latest.blob, latest.etag, req_headers,
+                    self._CC_LATEST, cache_key=("full", latest.epoch),
+                    created_wall=latest.created_wall,
+                    epoch=latest.epoch)
+            blob = tier.artifact.to_bytes()
+            from ct_mapreduce_tpu.distrib import publish as _pub
+
+            return self._blob_response(blob, _pub.etag_of(blob),
+                                       req_headers, self._CC_LATEST,
+                                       epoch=tier.epoch)
+        if parts[0] == "manifest":
+            if distributor is None:
+                return 404, {"error": "distribution store not armed"}
+            incr_counter("distrib", "http_manifest")
+            return 200, distributor.manifest()
+        if parts[0] == "container":
+            if latest is None:
+                return 404, {"error": "no published artifact"}
+            if len(parts) != 2 or parts[1] not in latest.containers:
+                return 404, {"error": "unknown container kind",
+                             "kinds": sorted(latest.containers)}
+            incr_counter("distrib", "http_container")
+            return self._blob_response(
+                latest.containers[parts[1]],
+                latest.container_etags[parts[1]], req_headers,
+                self._CC_LATEST,
+                cache_key=("container", latest.epoch, parts[1]),
+                created_wall=latest.created_wall, epoch=latest.epoch)
+        if parts[0] == "delta":
+            if distributor is None:
+                return 404, {"error": "distribution store not armed"}
+            if len(parts) != 3:
+                return 400, {"error": "use /filter/delta/<from>/<to>"}
+            try:
+                from_e, to_e = int(parts[1]), int(parts[2])
+            except ValueError:
+                return 400, {"error": "delta epochs must be integers"}
+            bundle = distributor.delta_bundle(from_e, to_e)
+            if bundle is None:
+                return 404, {"error": "no delta chain",
+                             "fromEpoch": from_e, "toEpoch": to_e,
+                             "hint": "full-pull /filter"}
+            incr_counter("distrib", "http_delta")
+            from ct_mapreduce_tpu.distrib import publish as _pub
+
+            return self._blob_response(
+                bundle, _pub.etag_of(bundle), req_headers,
+                self._CC_IMMUTABLE, cache_key=("delta", from_e, to_e),
+                epoch=to_e)
         if len(parts) != 2:
             return 400, {"error": "use /filter/<issuer>/<expDate>"}
-        blob = tier.artifact.group_bytes(parts[0], parts[1])
+        art = (tier.artifact if tier is not None
+               else None)
+        if art is None:
+            from ct_mapreduce_tpu.filter import FilterArtifact
+
+            art = FilterArtifact.from_bytes(latest.blob)
+        blob = art.group_bytes(parts[0], parts[1])
         if blob is None:
             return 404, {"error": "no filter group",
                          "issuer": parts[0], "expDate": parts[1]}
-        return 200, blob
+        from ct_mapreduce_tpu.distrib import publish as _pub
+
+        return self._blob_response(blob, _pub.etag_of(blob),
+                                   req_headers, self._CC_LATEST)
 
     def handle_healthz(self) -> tuple[int, dict]:
         from ct_mapreduce_tpu.telemetry.metrics import get_sink
@@ -493,7 +654,7 @@ class QueryServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            def _respond(self, code: int, body) -> None:
+            def _respond(self, code: int, body, headers=None) -> None:
                 if isinstance(body, (bytes, bytearray)):
                     payload, ctype = bytes(body), "application/octet-stream"
                 else:
@@ -502,6 +663,8 @@ class QueryServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
+                for name, value in sorted((headers or {}).items()):
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(payload)
                 if code >= 400:
@@ -541,7 +704,8 @@ class QueryServer:
                         from urllib.parse import unquote
 
                         self._respond(*server.handle_filter(
-                            unquote(path[len("/filter"):]).lstrip("/")))
+                            unquote(path[len("/filter"):]).lstrip("/"),
+                            req_headers=self.headers))
                     elif path == "/getcert":
                         from urllib.parse import parse_qsl
 

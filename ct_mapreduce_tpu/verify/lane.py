@@ -53,6 +53,7 @@ from typing import Optional
 
 import numpy as np
 
+from ct_mapreduce_tpu.config import profile as platprofile
 from ct_mapreduce_tpu.telemetry import trace
 from ct_mapreduce_tpu.telemetry.metrics import (
     add_sample,
@@ -66,6 +67,25 @@ DEFAULT_WINDOW = 8  # keep in sync with ops.ecdsa.DEFAULT_WINDOW
 VALID_WINDOWS = (0, 2, 4, 8)
 DEFAULT_QTABLE = 32  # per-curve device-resident Q-table slots
 
+_VERIFY_KNOBS = (
+    platprofile.Knob("verifySignatures", "CTMR_VERIFY", False,
+                     parse=lambda s: s.strip() == "1",
+                     env_is_set=platprofile.any_set, post=bool),
+    platprofile.Knob("verifyLogKeys", "CTMR_VERIFY_KEYS", "",
+                     parse=str, is_set=platprofile.nonempty_str),
+    platprofile.Knob("verifyBatch", "CTMR_VERIFY_BATCH", DEFAULT_BATCH,
+                     parse=int, is_set=platprofile.pos_int,
+                     post=lambda v: int(v)),
+    # -1 = unset; 0 is a REAL value (the legacy Jacobian ladder), so
+    # an explicit 0 must beat a stray env var.
+    platprofile.Knob("verifyPrecompWindow", "CTMR_VERIFY_PRECOMP_WINDOW",
+                     -1, parse=int, is_set=platprofile.nonneg_int),
+    platprofile.Knob("verifyQTableSize", "CTMR_VERIFY_QTABLE_SIZE",
+                     DEFAULT_QTABLE, parse=int,
+                     is_set=platprofile.pos_int,
+                     post=lambda v: int(v)),
+)
+
 
 def resolve_verify(flag: Optional[bool] = None,
                    keys_path: Optional[str] = None,
@@ -73,41 +93,27 @@ def resolve_verify(flag: Optional[bool] = None,
                    window: Optional[int] = None,
                    qtable_size: int = 0,
                    ) -> tuple[bool, str, int, int, int]:
-    """Resolve the verify-lane knobs: explicit value (config directive
-    / kwarg) > ``CTMR_VERIFY`` / ``CTMR_VERIFY_KEYS`` /
+    """Resolve the verify-lane knobs through the shared
+    platformProfile ladder (config/profile.py): explicit value (config
+    directive / kwarg) > ``CTMR_VERIFY`` / ``CTMR_VERIFY_KEYS`` /
     ``CTMR_VERIFY_BATCH`` / ``CTMR_VERIFY_PRECOMP_WINDOW`` /
-    ``CTMR_VERIFY_QTABLE_SIZE`` env > defaults (off; no key file;
-    1024-lane device batches; 8-bit precompute windows; 32 Q-table
-    slots). ``window = 0`` selects the legacy Jacobian ladder;
-    unparseable env values are ignored, matching the config layer's
-    tolerance."""
-    if flag is None:
-        flag = os.environ.get("CTMR_VERIFY", "0") == "1"
-    if not keys_path:
-        keys_path = os.environ.get("CTMR_VERIFY_KEYS", "")
-    b = int(batch or 0)
-    if b <= 0:
-        try:
-            b = int(os.environ.get("CTMR_VERIFY_BATCH", "0") or 0)
-        except ValueError:
-            b = 0
-    w = -1 if window is None else int(window)
-    if w < 0:
-        try:
-            w = int(os.environ.get("CTMR_VERIFY_PRECOMP_WINDOW", "")
-                    or -1)
-        except ValueError:
-            w = -1
+    ``CTMR_VERIFY_QTABLE_SIZE`` env > profile ``knobs.verify`` >
+    defaults (off; no key file; 1024-lane device batches; 8-bit
+    precompute windows; 32 Q-table slots). ``window = 0`` selects the
+    legacy Jacobian ladder; unparseable env values are ignored,
+    matching the config layer's tolerance."""
+    r = platprofile.resolve_section("verify", _VERIFY_KNOBS, {
+        "verifySignatures": flag,
+        "verifyLogKeys": keys_path or "",
+        "verifyBatch": int(batch or 0),
+        "verifyPrecompWindow": (-1 if window is None else int(window)),
+        "verifyQTableSize": int(qtable_size or 0),
+    })
+    w = int(r["verifyPrecompWindow"])
     if w < 0 or w not in VALID_WINDOWS:
         w = DEFAULT_WINDOW if w != 0 else 0
-    q = int(qtable_size or 0)
-    if q <= 0:
-        try:
-            q = int(os.environ.get("CTMR_VERIFY_QTABLE_SIZE", "0") or 0)
-        except ValueError:
-            q = 0
-    return (bool(flag), keys_path, (b if b > 0 else DEFAULT_BATCH),
-            w, (q if q > 0 else DEFAULT_QTABLE))
+    return (r["verifySignatures"], r["verifyLogKeys"],
+            r["verifyBatch"], w, r["verifyQTableSize"])
 
 
 class LogKeyRegistry:

@@ -176,6 +176,34 @@ def test_bench_smoke_filter_gate():
     # compiled table shape to keep the tier-1 budget.)
 
 
+@pytest.mark.timeout(180)
+def test_bench_smoke_distrib_gate():
+    """Distribution leg (ISSUE 13): run_distrib_smoke itself gates
+    worker byte-identity (full + containers over HTTP), client-side
+    delta-chain replay to the exact full filter, and delta+304 traffic
+    ≪ full-pull bytes; here we pin that the leg ran every pull class
+    with real work and the BENCHLOG numbers were recorded."""
+    import jax
+
+    if os.environ.get("CT_TPU_TESTS", "") == "":
+        jax.config.update("jax_platforms", "cpu")
+    import bench
+
+    out = bench.run_distrib_smoke()  # raises BenchError on any miss
+    assert out["metric"] == "ct_distrib_smoke"
+    assert out["value"] > 0
+    assert out["smoke_distrib_parity"] == 1
+    assert out["smoke_distrib_workers"] == 2
+    assert out["smoke_distrib_clients"] >= 500
+    assert out["smoke_distrib_ratio_304"] > 0.1
+    assert out["smoke_distrib_delta_304_vs_full"] < 0.20
+    assert out["smoke_distrib_wire_vs_counterfactual"] < 0.5
+    assert out["smoke_distrib_pulls"]["304"] > 0
+    assert out["smoke_distrib_pulls"]["delta"] > 0
+    assert out["smoke_distrib_pulls"]["full"] > 0
+    assert 0 < out["smoke_distrib_p50_ms"] <= out["smoke_distrib_p99_ms"]
+
+
 @pytest.mark.timeout(240)
 def test_bench_smoke_verify_gate():
     """Verify leg (ISSUE 8): run_verify_smoke itself gates verdict

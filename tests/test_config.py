@@ -325,3 +325,69 @@ def test_fleet_directives(tmp_path, monkeypatch):
     for d in ("numWorkers", "workerId", "checkpointPeriod",
               "coordinatorBackend"):
         assert d in usage
+
+
+def test_platform_profile_feeds_every_resolver(tmp_path, monkeypatch):
+    """platformProfile (ISSUE 13, ROADMAP item 1's unlocking
+    refactor): ONE data file supplies tuned knobs to every subsystem's
+    resolve_*, with the shared ladder explicit > env > profile >
+    default — a tuned device profile needs no code change."""
+    import json
+
+    for k in ("CTMR_PLATFORM_PROFILE", "CTMR_CHUNKS_PER_DISPATCH",
+              "CTMR_STAGING_DEPTH", "CTMR_SERVE_REPLICAS",
+              "CTMR_SERVE_DEVICE", "CTMR_SERVE_CACHE_SIZE",
+              "CTMR_VERIFY", "CTMR_VERIFY_BATCH",
+              "CTMR_VERIFY_PRECOMP_WINDOW", "CTMR_NUM_WORKERS",
+              "CTMR_EMIT_FILTER", "CTMR_FILTER_FP_RATE"):
+        monkeypatch.delenv(k, raising=False)
+    from ct_mapreduce_tpu.filter import resolve_filter
+    from ct_mapreduce_tpu.ingest.fleet import resolve_fleet
+    from ct_mapreduce_tpu.ingest.sync import resolve_staging
+    from ct_mapreduce_tpu.serve.server import resolve_serve
+    from ct_mapreduce_tpu.verify.lane import resolve_verify
+
+    prof = tmp_path / "tuned.json"
+    prof.write_text(json.dumps({
+        "version": 1, "platform": "test-box",
+        "knobs": {
+            "staging": {"chunksPerDispatch": 8, "stagingDepth": 3},
+            "serve": {"serveReplicas": 5, "serveDevice": False,
+                      "serveCacheSize": 512},
+            "verify": {"verifyBatch": 4096, "verifyPrecompWindow": 4},
+            "fleet": {"numWorkers": 4},
+            "filter": {"filterFpRate": 0.005},
+        }}))
+    monkeypatch.setenv("CTMR_PLATFORM_PROFILE", str(prof))
+    # Profile supplies the defaults...
+    assert resolve_staging() == (8, 3)
+    assert resolve_serve() == (5, False, 512)
+    assert resolve_verify()[2] == 4096
+    assert resolve_verify()[3] == 4
+    assert resolve_fleet()[0] == 4
+    assert resolve_filter()[2] == 0.005
+    # ...env beats profile...
+    monkeypatch.setenv("CTMR_STAGING_DEPTH", "5")
+    monkeypatch.setenv("CTMR_SERVE_REPLICAS", "9")
+    monkeypatch.setenv("CTMR_VERIFY_PRECOMP_WINDOW", "8")
+    assert resolve_staging() == (8, 5)
+    assert resolve_serve()[0] == 9
+    assert resolve_verify()[3] == 8
+    # ...and an explicit directive/kwarg beats both (incl. the
+    # 0-is-real sentinel knobs).
+    assert resolve_staging(chunks_per_dispatch=2) == (2, 5)
+    assert resolve_verify(window=0)[3] == 0
+    # An unreadable profile resolves as if absent (no crash).
+    monkeypatch.setenv("CTMR_PLATFORM_PROFILE", str(tmp_path / "nope"))
+    monkeypatch.delenv("CTMR_STAGING_DEPTH")
+    assert resolve_staging() == (1, 2)
+    # The directive parses and is documented.
+    ini = tmp_path / "p.ini"
+    ini.write_text(f"platformProfile = {prof}\ndistribHistory = 6\n"
+                   "maxDeltaChain = 3\n")
+    cfg = CTConfig.load(argv=["--config", str(ini)], env={})
+    assert cfg.platform_profile == str(prof)
+    assert cfg.distrib_history == 6 and cfg.max_delta_chain == 3
+    usage = CTConfig().usage()
+    for d in ("platformProfile", "distribHistory", "maxDeltaChain"):
+        assert d in usage

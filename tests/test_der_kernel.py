@@ -407,3 +407,54 @@ def test_mutation_fuzz_walker_host_agreement():
     rate = snap["gauges"]["parse.device_accept_rate"]
     assert 0 < rate <= 1 and rate == accepted / report.total
     assert snap["counters"]["parse.divergence_verdict_mismatch"] == 0.0
+
+
+def test_grammar_mutation_fuzz_buckets():
+    """ROADMAP 5(a) increment: the grammar-aware mutators (length-
+    field surgery, nested-TLV truncation/extension per ParsEval's
+    methodology, arxiv 2405.18993) produce STRUCTURALLY plausible
+    disagreement-inducing corpora — valid TLV trees with one
+    inconsistent length — instead of random byte noise. Contract:
+
+    - the hard bucket stays EMPTY on the structured corpus too (both
+      parsers accepting with a differing identity field would mean a
+      length inconsistency silently moved an identity window);
+    - `parse.device_accept_rate` is PUBLISHED by this fuzz (the
+      standing campaign trends it; a silent drop of the gauge would
+      hide a walker regression) and the buckets are consistent;
+    - the mutators really perturb structure (mutants differ from
+      their bases) and the corpus still exercises accept paths.
+
+    Runs at the single-byte fuzz's exact corpus shape (300 lanes,
+    pad 1024) so the device walker reuses the compiled shape."""
+    from ct_mapreduce_tpu.core import divergence
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
+    rng = np.random.default_rng(20260805)
+    bases = fixture_certs()
+    mutants = divergence.grammar_mutants(bases, rng, 300)
+    assert len(mutants) == 300
+    assert sum(m not in bases for m in mutants) > 250, \
+        "mutators barely perturbed the corpus"
+
+    sink = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink)
+    try:
+        report = divergence.classify_corpus(mutants)
+        divergence.publish(report)
+        snap = sink.snapshot()
+    finally:
+        tmetrics.set_sink(prev)
+
+    for line in report.details:
+        print(line)
+    assert report.verdict_mismatch == 0, report.details
+    assert (report.both_accept + report.device_accept_host_reject
+            == report.device_accepts)
+    assert (report.both_accept + report.host_accept_device_reject
+            == report.host_accepts)
+    # The trend gauge cannot silently drop out of the fuzz.
+    rate = snap["gauges"]["parse.device_accept_rate"]
+    assert rate == report.device_accepts / report.total
+    assert snap["counters"]["parse.divergence_verdict_mismatch"] == 0.0

@@ -1,0 +1,318 @@
+"""pullstorm: simulated client pull storm against a multi-worker
+filter-distribution fleet (ISSUE 13's load proof; ROADMAP item 4).
+
+Builds an epoch sequence of deterministic filter artifacts (synthetic
+(issuer, expDate) serial sets with per-epoch churn), publishes every
+epoch into W serving workers through the SAME fan-out path ct-fetch
+uses in a fleet (``oracle.publish_artifact(..., source="fleet")`` —
+byte-identical input on every worker, exactly what the leader's
+merged-artifact tick delivers), verifies the workers really serve
+byte-identical artifacts (full + every container) over HTTP, then
+storms them with N simulated clients:
+
+- **warm** clients (zipf lag 0) hold the latest ETag and issue a
+  conditional GET — the steady state, answered ``304`` with zero body
+  bytes;
+- **lagging** clients (zipf-distributed epoch lag) pull
+  ``GET /filter/delta/<theirs>/<latest>``, validate each link against
+  the chain manifest, and replay — falling back to a full pull when
+  the chain is anchored/evicted away (404);
+- **cold** clients full-pull ``GET /filter`` with gzip negotiation
+  (a configurable fraction pulls an upstream container instead).
+
+Reports bytes-on-wire against the full-pull counterfactual, the 304
+ratio, and latency percentiles. A scaled-down leg gates in tier-1
+via ``bench.run_distrib_smoke``; the full 10K-client run is recorded
+in BENCHLOG.
+
+    python tools/pullstorm.py --clients 10000 --epochs 6 --workers 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import queue
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_epoch_blobs(n_epochs: int, groups: int, per_group: int,
+                      churn: int, seed: int) -> list[bytes]:
+    """Deterministic epoch sequence: ``groups`` (issuer, expDate)
+    sets, ``churn`` groups gaining serials per epoch (the crlite
+    shape: most groups untouched epoch to epoch)."""
+    from ct_mapreduce_tpu.filter import build_artifact
+
+    rng = np.random.default_rng(seed)
+    sets = {
+        (f"issuer-{g:03d}", 500_000 + 24 * g): {
+            bytes([g % 251, s % 251, 7])
+            + bytes([int(x) for x in rng.integers(0, 256, 3)])
+            for s in range(per_group)
+        }
+        for g in range(groups)
+    }
+    blobs = []
+    for e in range(n_epochs):
+        if e:
+            keys = sorted(sets)
+            for i in range(churn):
+                key = keys[(e * churn + i) % len(keys)]
+                sets[key] = set(sets[key]) | {
+                    bytes([e % 251, i % 251])
+                    + bytes([int(x) for x in rng.integers(0, 256, 3)])
+                    for _ in range(max(1, per_group // 10))}
+        blobs.append(build_artifact(sets, fp_rate=0.01,
+                                    use_device=False).to_bytes())
+    return blobs
+
+
+def start_fleet(blobs: list[bytes], workers: int,
+                max_chain: int) -> list:
+    """W serving workers, each fed every epoch through the fleet
+    fan-out path. Returns the started QueryServers."""
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.serve.server import QueryServer
+
+    servers = []
+    for _ in range(workers):
+        agg = TpuAggregator(capacity=1 << 10, batch_size=64)
+        agg.enable_filter_capture()
+        srv = QueryServer(agg, 0, filter_first=True,
+                          max_delta_chain=max_chain,
+                          distrib_history=len(blobs) + 1).start()
+        for e, blob in enumerate(blobs):
+            srv.oracle.publish_artifact(e, blob, source="fleet")
+        servers.append(srv)
+    return servers
+
+
+def verify_fleet_parity(bases: list[str]) -> dict:
+    """Every worker serves byte-identical artifacts: full, every
+    container, and the manifest's latest hash. Returns the reference
+    payload sizes."""
+    fulls, etags = [], []
+    for base in bases:
+        r = urllib.request.urlopen(base + "/filter")
+        fulls.append(r.read())
+        etags.append(r.headers["ETag"])
+    if len({f for f in fulls}) != 1 or len(set(etags)) != 1:
+        raise RuntimeError("workers serve DIFFERENT full artifacts")
+    sizes = {"full": len(fulls[0]), "etag": etags[0]}
+    man = json.loads(urllib.request.urlopen(
+        bases[0] + "/filter/manifest").read())
+    for kind in man["containers"]:
+        payloads = []
+        for base in bases:
+            payloads.append(urllib.request.urlopen(
+                f"{base}/filter/container/{kind}").read())
+        if len(set(payloads)) != 1:
+            raise RuntimeError(f"workers serve DIFFERENT {kind} "
+                               f"containers")
+        sizes[kind] = len(payloads[0])
+    sizes["manifest"] = man
+    return sizes
+
+
+def run_storm(clients: int = 10_000, epochs: int = 5, groups: int = 40,
+              per_group: int = 50, churn: int = 2, workers: int = 2,
+              threads: int = 32, max_chain: int = 4,
+              cold_fraction: float = 0.05,
+              container_fraction: float = 0.2, zipf_a: float = 1.6,
+              seed: int = 20260805, validate_every: int = 50) -> dict:
+    """The full storm. Returns the report dict (also printed as JSON
+    by the CLI)."""
+    from ct_mapreduce_tpu.distrib import (
+        ChainManifest,
+        apply_chain,
+        split_bundle,
+    )
+
+    blobs = build_epoch_blobs(epochs, groups, per_group, churn, seed)
+    servers = start_fleet(blobs, workers, max_chain)
+    bases = [f"http://127.0.0.1:{s.port}" for s in servers]
+    try:
+        sizes = verify_fleet_parity(bases)
+        man = sizes.pop("manifest")
+        latest = man["latestEpoch"]
+        manifest = ChainManifest.from_json(man)
+        latest_etag = sizes["etag"]
+        full_size = sizes["full"]
+
+        # Client plan: zipf epoch lag (0 = warm), a cold slice, a
+        # container-pulling slice of the colds.
+        rng = np.random.default_rng(seed + 1)
+        lags = (rng.zipf(zipf_a, size=clients) - 1).clip(0, epochs - 1)
+        cold = rng.random(clients) < cold_fraction
+        wants_container = rng.random(clients) < container_fraction
+        kinds = sorted(k for k in sizes if k not in ("full", "etag"))
+
+        tasks: queue.Queue = queue.Queue()
+        for i in range(clients):
+            tasks.put(i)
+        lock = threading.Lock()
+        results = []
+        errors = []
+
+        def one_pull(i: int) -> tuple:
+            base = bases[i % len(bases)]
+            t0 = time.monotonic()
+            if cold[i]:
+                if wants_container[i] and kinds:
+                    kind = kinds[i % len(kinds)]
+                    r = urllib.request.urlopen(
+                        f"{base}/filter/container/{kind}")
+                    return "container", len(r.read()), t0
+                req = urllib.request.Request(
+                    base + "/filter",
+                    headers={"Accept-Encoding": "gzip"})
+                r = urllib.request.urlopen(req)
+                body = r.read()
+                if r.headers.get("Content-Encoding") == "gzip":
+                    gzip.decompress(body)  # client really can use it
+                return "full", len(body), t0
+            lag = int(lags[i])
+            if lag == 0:
+                req = urllib.request.Request(
+                    base + "/filter",
+                    headers={"If-None-Match": latest_etag})
+                try:
+                    r = urllib.request.urlopen(req)
+                    return "full", len(r.read()), t0  # ETag rotated
+                except urllib.error.HTTPError as err:
+                    if err.code != 304:
+                        raise
+                    err.read()
+                    return "304", 0, t0
+            try:
+                req = urllib.request.Request(
+                    f"{base}/filter/delta/{latest - lag}/{latest}",
+                    headers={"Accept-Encoding": "gzip"})
+                r = urllib.request.urlopen(req)
+                wire = r.read()
+                bundle = (gzip.decompress(wire)
+                          if r.headers.get("Content-Encoding") == "gzip"
+                          else wire)
+            except urllib.error.HTTPError as err:
+                if err.code != 404:
+                    raise
+                err.read()
+                # Anchored/evicted out: the documented fallback.
+                r = urllib.request.urlopen(base + "/filter")
+                return "fallback_full", len(r.read()), t0
+            if i % validate_every == 0:
+                links = split_bundle(bundle)
+                manifest.validate_chain(latest - lag, latest, links)
+                if apply_chain(blobs[latest - lag], links) \
+                        != blobs[latest]:
+                    raise RuntimeError(
+                        f"delta replay mismatch (lag {lag})")
+            return "delta", len(wire), t0
+
+        def worker_loop():
+            while True:
+                try:
+                    i = tasks.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    kind, n_bytes, t0 = one_pull(i)
+                    dt = time.monotonic() - t0
+                    with lock:
+                        results.append((kind, n_bytes, dt))
+                except Exception as err:  # noqa: BLE001 — report, don't hang
+                    with lock:
+                        errors.append(f"client {i}: "
+                                      f"{type(err).__name__}: {err}")
+
+        t_start = time.monotonic()
+        pool = [threading.Thread(target=worker_loop, daemon=True)
+                for _ in range(threads)]
+        for t in pool:
+            t.start()
+        for t in pool:
+            t.join()
+        wall = time.monotonic() - t_start
+        if errors:
+            raise RuntimeError(
+                f"{len(errors)} client failures, first: {errors[0]}")
+
+        by_kind: dict = {}
+        for kind, n_bytes, _ in results:
+            cnt, tot = by_kind.get(kind, (0, 0))
+            by_kind[kind] = (cnt + 1, tot + n_bytes)
+        lat = np.array(sorted(dt for _, _, dt in results))
+        bytes_on_wire = sum(tot for _, tot in by_kind.values())
+        n304 = by_kind.get("304", (0, 0))[0]
+        n_delta, delta_bytes = by_kind.get("delta", (0, 0))
+        counterfactual = len(results) * full_size
+        d3_clients = n304 + n_delta
+        d3_bytes = delta_bytes  # 304s add zero body bytes
+        report = {
+            "clients": len(results),
+            "workers": workers,
+            "epochs": epochs,
+            "full_artifact_bytes": full_size,
+            "pulls": {k: {"count": c, "bytes": b}
+                      for k, (c, b) in sorted(by_kind.items())},
+            "ratio_304": round(n304 / max(1, len(results)), 4),
+            "bytes_on_wire": bytes_on_wire,
+            "counterfactual_full_bytes": counterfactual,
+            "wire_vs_counterfactual": round(
+                bytes_on_wire / max(1, counterfactual), 4),
+            "delta_304_clients": d3_clients,
+            "delta_304_bytes": d3_bytes,
+            "delta_304_counterfactual": d3_clients * full_size,
+            "delta_304_vs_full": round(
+                d3_bytes / max(1, d3_clients * full_size), 4),
+            "p50_ms": round(float(lat[len(lat) // 2]) * 1e3, 3),
+            "p99_ms": round(float(lat[int(len(lat) * 0.99)]) * 1e3, 3),
+            "wall_s": round(wall, 3),
+            "pulls_per_s": round(len(results) / max(wall, 1e-9), 1),
+            "worker_parity": 1,
+            "zstd_available": "zstd" in man["encodings"],
+        }
+        return report
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="pullstorm")
+    p.add_argument("--clients", type=int, default=10_000)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--groups", type=int, default=40)
+    p.add_argument("--per-group", type=int, default=50)
+    p.add_argument("--churn", type=int, default=2)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--threads", type=int, default=32)
+    p.add_argument("--max-chain", type=int, default=4)
+    p.add_argument("--cold", type=float, default=0.05)
+    p.add_argument("--containers", type=float, default=0.2)
+    p.add_argument("--zipf", type=float, default=1.6)
+    p.add_argument("--seed", type=int, default=20260805)
+    args = p.parse_args(argv)
+    report = run_storm(
+        clients=args.clients, epochs=args.epochs, groups=args.groups,
+        per_group=args.per_group, churn=args.churn,
+        workers=args.workers, threads=args.threads,
+        max_chain=args.max_chain, cold_fraction=args.cold,
+        container_fraction=args.containers, zipf_a=args.zipf,
+        seed=args.seed)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
