@@ -2055,6 +2055,139 @@ def run_filter_smoke() -> dict:
     }
 
 
+def run_filter_scale_smoke() -> dict:
+    """CT_BENCH_SMOKE scaled-filter-build leg (round 19), CPU-only.
+
+    A scaled-down packed corpus (40K serials / 12 groups, plus one
+    list-sourced group carrying an oversized host-lane serial) builds
+    through the fused multi-group dispatcher and the leg enforces the
+    round-19 acceptance shape:
+
+      (1) BYTE IDENTITY across every build path — fused (device),
+          fused (NumPy lane), streamed at a prime chunk size, and the
+          round-15 per-group reference path all serialize the same
+          CTMRFL01 bytes;
+      (2) the dispatch collapse really happened — fused scatter
+          dispatches ≪ per-(group, layer) count, with >2 groups per
+          dispatch on average (the lever is dispatch fusion, not
+          hardware);
+      (3) the capture spill ring changes nothing — a byte-budgeted
+          ring spills segments (spilled bytes > 0) and its merged
+          items build the same artifact as an in-memory dict capture.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as _np
+
+    from ct_mapreduce_tpu.filter import (
+        ListGroupSource,
+        SpillCaptureRing,
+        build_artifact,
+        build_artifact_from_sources,
+    )
+    from ct_mapreduce_tpu.filter import artifact as fartifact
+    from tools.filtercost import packed_sources
+
+    n, groups, rate = 40_000, 12, 0.01
+
+    def sources():
+        srcs = packed_sources(n, groups, seed=20260805)
+        big = [b"\x9c" * 61, b"\x9d" * 72]  # oversized host-lane keys
+        small = [bytes([7, j % 251, 3]) for j in range(50)]
+        srcs.append(ListGroupSource("scale-smoke-oversized", 777_000,
+                                    small + big))
+        return srcs
+
+    t0 = time.monotonic()
+    art = build_artifact_from_sources(sources(), fp_rate=rate)
+    fused_s = time.monotonic() - t0
+    stats = fartifact.LAST_BUILD_STATS
+    blob = art.to_bytes()
+    total = art.n_serials
+    if stats is None:
+        raise BenchError("filter scale smoke: fused build did not "
+                         "record dispatch stats (fused path not taken)")
+
+    # (2) dispatch collapse: the per-group path would issue one
+    # scatter per (group, layer).
+    if not (stats.dispatches < stats.layers):
+        raise BenchError(
+            f"filter scale smoke: no dispatch collapse "
+            f"({stats.dispatches} dispatches vs {stats.layers} layers)")
+    gpd = stats.mean_groups_per_dispatch()
+    if gpd <= 2.0:
+        raise BenchError(
+            f"filter scale smoke: groups/dispatch {gpd:.2f} <= 2")
+
+    # (1) byte identity across every path.
+    legacy = build_artifact_from_sources(
+        sources(), fp_rate=rate, fused=False).to_bytes()
+    if legacy != blob:
+        raise BenchError("filter scale smoke: fused != per-group bytes")
+    streamed = build_artifact_from_sources(
+        sources(), fp_rate=rate, stream_chunk=509,
+        fused_lanes=4096).to_bytes()
+    if streamed != blob:
+        raise BenchError("filter scale smoke: streamed != fused bytes")
+    host = build_artifact_from_sources(
+        sources(), fp_rate=rate, use_device=False).to_bytes()
+    if host != blob:
+        raise BenchError("filter scale smoke: NumPy lane != device "
+                         "bytes")
+
+    # (3) spill ring parity: tiny byte budget forces real segment
+    # spills; merged items == the dict capture's content.
+    import tempfile as _tempfile
+
+    rng = _np.random.default_rng(99)
+    spill_dir = _tempfile.mkdtemp(prefix="ct-filter-spill-smoke-")
+    ring = SpillCaptureRing(spill_dir, mem_bytes=4096)
+    plain: dict = {}
+    for j in range(3000):
+        key = (int(rng.integers(0, 3)), 600_000 + int(rng.integers(0, 2)))
+        sb = rng.integers(0, 256, 12, dtype=_np.uint8).tobytes()
+        ring.add(key, sb)
+        plain.setdefault(key, set()).add(sb)
+    if not ring.spilled_bytes:
+        raise BenchError("filter scale smoke: spill ring never spilled")
+    ring_state = {(f"spill-{idx}", eh): serials
+                  for (idx, eh), serials in ring.items()}
+    dict_state = {(f"spill-{idx}", eh): serials
+                  for (idx, eh), serials in sorted(plain.items())}
+    if build_artifact(ring_state, fp_rate=rate).to_bytes() != \
+            build_artifact(dict_state, fp_rate=rate).to_bytes():
+        raise BenchError("filter scale smoke: spilled capture builds "
+                         "different bytes than the dict capture")
+
+    rate_sps = total / max(fused_s, 1e-9)
+    log(f"filter scale smoke: {total} serials / {len(art.groups)} "
+        f"groups -> {len(blob)} B in {fused_s:.2f}s "
+        f"({rate_sps:.0f} serials/s); {stats.layers} layers in "
+        f"{stats.dispatches} dispatches ({gpd:.1f} groups/dispatch, "
+        f"{stats.escalations} escalations); spill ring "
+        f"{ring.spilled_bytes} B over {ring.stats()['segments']} segs")
+    return {
+        "metric": "ct_filter_scale_smoke",
+        "value": rate_sps,
+        "unit": "serials/s",
+        "smoke_fscale_serials": total,
+        "smoke_fscale_groups": len(art.groups),
+        "smoke_fscale_bytes": len(blob),
+        "smoke_fscale_build_s": fused_s,
+        "smoke_fscale_layers": stats.layers,
+        "smoke_fscale_dispatches": stats.dispatches,
+        "smoke_fscale_device_dispatches": stats.device_dispatches,
+        "smoke_fscale_groups_per_dispatch": gpd,
+        "smoke_fscale_layer_rounds": stats.rounds,
+        "smoke_fscale_escalations": stats.escalations,
+        "smoke_fscale_byte_identity": 1,
+        "smoke_fscale_spilled_bytes": ring.spilled_bytes,
+        "smoke_fscale_spill_segments": ring.stats()["segments"],
+    }
+
+
 def run_distrib_smoke() -> dict:
     """CT_BENCH_SMOKE distribution leg (round 18): a scaled-down
     client pull storm against a W=2 serving fleet, CPU-only — the

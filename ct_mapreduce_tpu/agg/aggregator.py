@@ -53,6 +53,7 @@ import numpy as np
 from ct_mapreduce_tpu.core import der as hostder
 from ct_mapreduce_tpu.core import packing
 from ct_mapreduce_tpu.core.types import ExpDate, Issuer
+from ct_mapreduce_tpu.filter.spill import SpillCaptureRing
 from ct_mapreduce_tpu.ops import buckettable, der_kernel, hashtable, pipeline
 from ct_mapreduce_tpu.telemetry import trace
 from ct_mapreduce_tpu.telemetry.metrics import incr_counter, set_gauge
@@ -798,8 +799,9 @@ class TpuAggregator:
                 )
         return out
 
-    # -- filter capture (round 15) ---------------------------------------
-    def enable_filter_capture(self) -> None:
+    # -- filter capture (round 15; spill ring round 19) ------------------
+    def enable_filter_capture(self, spill_dir: str = "",
+                              spill_mem_bytes: int = 0) -> None:
         """Start retaining first-seen serial bytes per (issuer_idx,
         exp_hour) for filter compilation. Seeds from the host-lane
         sets (their bytes survive checkpoints); device-lane serials
@@ -807,7 +809,24 @@ class TpuAggregator:
         recovered — enabling mid-life on a warm table yields a filter
         covering the capture window, and says so once on stderr.
         Forces ``want_serials`` (capture needs the bytes the count-only
-        fast path skips)."""
+        fast path skips).
+
+        With ``spill_dir`` (the ``filterCaptureSpillDir`` directive)
+        the capture is a :class:`SpillCaptureRing`: RSS bounded by
+        ``spill_mem_bytes``, overflow spilled to durable segment files
+        (checkpoint/merge/build surfaces unchanged — the ring's
+        ``items()`` is the dict's). An existing dict capture (e.g. a
+        restored checkpoint) is folded into the ring."""
+        if spill_dir and not isinstance(self.filter_capture,
+                                        SpillCaptureRing):
+            ring = SpillCaptureRing(spill_dir,
+                                    mem_bytes=spill_mem_bytes)
+            seed = (self.filter_capture
+                    if self.filter_capture is not None
+                    else self.host_serials)
+            for key, serials in sorted(seed.items()):
+                ring.update(key, sorted(serials))
+            self.filter_capture = ring
         if self.filter_capture is None:
             self.filter_capture = {
                 key: set(serials)
@@ -823,21 +842,28 @@ class TpuAggregator:
         self.want_serials = True
 
     def configure_filter_emission(self, path: str,
-                                  fp_rate: float = 0.01) -> None:
+                                  fp_rate: float = 0.01,
+                                  spill_dir: str = "",
+                                  spill_mem_bytes: int = 0) -> None:
         """Emit a filter artifact (``path``) on every checkpoint save,
         compiled from the capture at the target FP rate."""
         self.emit_filter_path = path
         if fp_rate > 0:
             self.filter_fp_rate = float(fp_rate)
-        self.enable_filter_capture()
+        self.enable_filter_capture(spill_dir=spill_dir,
+                                   spill_mem_bytes=spill_mem_bytes)
 
     def _capture_serial(self, issuer_idx: int, exp_hour: int,
                         serial: bytes) -> None:
         """Record one first-seen serial (fold paths call this under
         the fold lock; set semantics absorb cross-domain repeats)."""
-        if self.filter_capture is not None:
-            self.filter_capture.setdefault(
-                (issuer_idx, exp_hour), set()).add(serial)
+        cap = self.filter_capture
+        if cap is None:
+            return
+        if isinstance(cap, SpillCaptureRing):
+            cap.add((issuer_idx, exp_hour), serial)
+        else:
+            cap.setdefault((issuer_idx, exp_hour), set()).add(serial)
 
     # -- ingest ----------------------------------------------------------
     def ingest(self, entries: list[tuple[bytes, bytes]]) -> IngestResult:

@@ -40,6 +40,13 @@ SCOPE_MODULES: tuple[str, ...] = (
     # scope: Last-Modified wall stamps are header state, not bytes.)
     "ct_mapreduce_tpu/distrib/delta.py",
     "ct_mapreduce_tpu/distrib/container.py",
+    # Round 19 — the scaled build path: streamed key production, the
+    # fused multi-group layer dispatcher, and the capture spill ring
+    # all feed artifact bytes; none may read a clock or iterate in
+    # hash order.
+    "ct_mapreduce_tpu/filter/stream.py",
+    "ct_mapreduce_tpu/filter/fused.py",
+    "ct_mapreduce_tpu/filter/spill.py",
 )
 
 # (module pattern, function name): serialization paths inside

@@ -248,14 +248,24 @@ def main(argv: list[str] | None = None) -> int:
     # additionally emits the MERGED fleet filter each epoch below.
     from ct_mapreduce_tpu.filter import resolve_filter
 
-    emit_filter, base_filter_path, filter_fp = resolve_filter(
+    fknobs = resolve_filter(
         config.emit_filter or None, config.filter_path,
-        config.filter_fp_rate, state_path=base_state_path)
+        config.filter_fp_rate, state_path=base_state_path,
+        spill_dir=config.filter_capture_spill_dir,
+        spill_mb=config.filter_capture_spill_mb,
+        stream_chunk=config.filter_stream_chunk,
+        fused_lanes=config.filter_fused_lanes)
+    emit_filter, base_filter_path, filter_fp = (
+        fknobs.emit, fknobs.path, fknobs.fp_rate)
     if emit_filter and model is not None:
         model.aggregator.configure_filter_emission(
             worker_state_path(base_filter_path, fleet_worker_id,
                               num_workers),
-            filter_fp)
+            filter_fp,
+            spill_dir=(worker_state_path(fknobs.spill_dir,
+                                         fleet_worker_id, num_workers)
+                       if fknobs.spill_dir else ""),
+            spill_mem_bytes=fknobs.spill_mb << 20)
     elif emit_filter:
         print("emitFilter ignored: filter emission needs backend = tpu",
               file=sys.stderr)

@@ -111,6 +111,15 @@ class CTConfig:
     # ("" = CTMR_FILTER_PATH env, then <aggStatePath>.filter)
     filter_fp_rate: float = 0.0  # target layer-0 false-positive rate
     # (0 = CTMR_FILTER_FP_RATE env, then 0.01)
+    filter_capture_spill_dir: str = ""  # spill-ring directory bounding
+    # filter-capture RSS ("" = CTMR_FILTER_SPILL_DIR env, then
+    # in-memory capture — round 19)
+    filter_capture_spill_mb: int = 0  # capture memory tier in MB before
+    # a spill flush (0 = CTMR_FILTER_SPILL_MB env, then 256)
+    filter_stream_chunk: int = 0  # serials per streamed key block of
+    # the filter build (0 = CTMR_FILTER_STREAM_CHUNK env, then 2^16)
+    filter_fused_lanes: int = 0  # lanes per fused filter-build scatter
+    # dispatch (0 = CTMR_FILTER_FUSED_LANES env, then 2^20)
     platform_profile: str = ""  # tuned-knob profile JSON (one loader
     # for every subsystem's resolve_*; "" = CTMR_PLATFORM_PROFILE env)
     distrib_history: int = 0  # filter-distribution epochs held per
@@ -172,6 +181,10 @@ class CTConfig:
         "emitFilter": ("emit_filter", bool),
         "filterPath": ("filter_path", str),
         "filterFpRate": ("filter_fp_rate", float),
+        "filterCaptureSpillDir": ("filter_capture_spill_dir", str),
+        "filterCaptureSpillMB": ("filter_capture_spill_mb", int),
+        "filterStreamChunk": ("filter_stream_chunk", int),
+        "filterFusedLanes": ("filter_fused_lanes", int),
         "platformProfile": ("platform_profile", str),
         "distribHistory": ("distrib_history", int),
         "maxDeltaChain": ("max_delta_chain", int),
@@ -387,6 +400,22 @@ class CTConfig:
             "filterFpRate = target layer-0 false-positive rate of the "
             "filter cascade (CTMR_FILTER_FP_RATE equivalent; default "
             "0.01; included serials are exact regardless)",
+            "filterCaptureSpillDir = spill-ring directory for the "
+            "filter capture: serial bytes overflow to durable segment "
+            "files so capture RSS is bounded by filterCaptureSpillMB, "
+            "not corpus size (CTMR_FILTER_SPILL_DIR equivalent; "
+            "default in-memory capture; per-worker suffixed in a "
+            "fleet; artifacts byte-identical either way)",
+            "filterCaptureSpillMB = capture memory tier in MB before "
+            "a spill flush (CTMR_FILTER_SPILL_MB equivalent; default "
+            "256; only meaningful with filterCaptureSpillDir)",
+            "filterStreamChunk = serials per streamed key block of "
+            "the filter build (CTMR_FILTER_STREAM_CHUNK equivalent; "
+            "default 2^16; bounds build transients, changes no bytes)",
+            "filterFusedLanes = lanes per fused filter-build scatter "
+            "dispatch (CTMR_FILTER_FUSED_LANES equivalent; default "
+            "2^20; CTMR_FILTER_FUSED=0 forces the per-group build "
+            "path — byte-identical)",
             "platformProfile = tuned-knob profile JSON file "
             "(CTMR_PLATFORM_PROFILE equivalent): one loader feeds "
             "every subsystem's knob resolution, so a tuned device "

@@ -15,11 +15,14 @@ compiled from the aggregation state (ROADMAP item 5(b), round 15).
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 from ct_mapreduce_tpu.config import profile as platprofile
 from ct_mapreduce_tpu.filter.artifact import (  # noqa: F401
     DEFAULT_FP_RATE,
     FilterArtifact,
     build_artifact,
+    build_artifact_from_sources,
     build_from_aggregator,
     build_from_merged,
     canonical_keys,
@@ -29,6 +32,11 @@ from ct_mapreduce_tpu.filter.artifact import (  # noqa: F401
 from ct_mapreduce_tpu.filter.cascade import (  # noqa: F401
     BloomLayer,
     FilterCascade,
+)
+from ct_mapreduce_tpu.filter.spill import SpillCaptureRing  # noqa: F401
+from ct_mapreduce_tpu.filter.stream import (  # noqa: F401
+    ListGroupSource,
+    PackedGroupSource,
 )
 
 
@@ -42,26 +50,64 @@ _FILTER_KNOBS = (
                      DEFAULT_FP_RATE, parse=float,
                      is_set=platprofile.pos_float,
                      post=lambda v: float(v)),
+    # Round 19 — scaled builds: capture spill ring + streamed/fused
+    # build shapes. 0/empty = built-in defaults (spill off).
+    platprofile.Knob("filterCaptureSpillDir", "CTMR_FILTER_SPILL_DIR",
+                     "", parse=str, is_set=platprofile.nonempty_str),
+    platprofile.Knob("filterCaptureSpillMB", "CTMR_FILTER_SPILL_MB",
+                     256, parse=int, is_set=platprofile.pos_int,
+                     post=int),
+    platprofile.Knob("filterStreamChunk", "CTMR_FILTER_STREAM_CHUNK",
+                     0, parse=int, is_set=platprofile.pos_int,
+                     post=int),
+    platprofile.Knob("filterFusedLanes", "CTMR_FILTER_FUSED_LANES",
+                     0, parse=int, is_set=platprofile.pos_int,
+                     post=int),
 )
 
 
+class FilterKnobs(NamedTuple):
+    emit: bool
+    path: str
+    fp_rate: float
+    spill_dir: str
+    spill_mb: int
+    stream_chunk: int  # 0 = stream.DEFAULT_STREAM_CHUNK
+    fused_lanes: int  # 0 = fused.DEFAULT_MAX_LANES
+
+
 def resolve_filter(emit=None, path: str = "", fp_rate: float = 0.0,
-                   state_path: str = "") -> tuple[bool, str, float]:
-    """Resolve the filter-emission knobs through the shared
-    platformProfile ladder (config/profile.py): explicit value (config
-    directive / kwarg) > ``CTMR_EMIT_FILTER`` / ``CTMR_FILTER_PATH`` /
-    ``CTMR_FILTER_FP_RATE`` env > profile ``knobs.filter`` > defaults
-    (off; ``<aggStatePath>.filter``; 0.01 target FP rate). Unparseable
-    env values are ignored, matching the config layer's tolerance."""
+                   state_path: str = "", spill_dir: str = "",
+                   spill_mb: int = 0, stream_chunk: int = 0,
+                   fused_lanes: int = 0) -> FilterKnobs:
+    """Resolve the filter knobs through the shared platformProfile
+    ladder (config/profile.py): explicit value (config directive /
+    kwarg) > ``CTMR_EMIT_FILTER`` / ``CTMR_FILTER_PATH`` /
+    ``CTMR_FILTER_FP_RATE`` / ``CTMR_FILTER_SPILL_DIR`` /
+    ``CTMR_FILTER_SPILL_MB`` / ``CTMR_FILTER_STREAM_CHUNK`` /
+    ``CTMR_FILTER_FUSED_LANES`` env > profile ``knobs.filter`` >
+    defaults (off; ``<aggStatePath>.filter``; 0.01 target FP rate;
+    spill off with a 256 MB memory tier; built-in stream/fused
+    shapes). Unparseable env values are ignored, matching the config
+    layer's tolerance."""
     r = platprofile.resolve_section("filter", _FILTER_KNOBS, {
         "emitFilter": emit,
         "filterPath": path or "",
         "filterFpRate": float(fp_rate or 0.0),
+        "filterCaptureSpillDir": spill_dir or "",
+        "filterCaptureSpillMB": int(spill_mb or 0),
+        "filterStreamChunk": int(stream_chunk or 0),
+        "filterFusedLanes": int(fused_lanes or 0),
     })
     p = r["filterPath"]
     if not p and state_path:
         p = state_path + ".filter"
-    return r["emitFilter"], p, r["filterFpRate"]
+    return FilterKnobs(
+        emit=r["emitFilter"], path=p, fp_rate=r["filterFpRate"],
+        spill_dir=r["filterCaptureSpillDir"],
+        spill_mb=r["filterCaptureSpillMB"],
+        stream_chunk=r["filterStreamChunk"],
+        fused_lanes=r["filterFusedLanes"])
 
 
 __all__ = [
@@ -69,7 +115,12 @@ __all__ = [
     "BloomLayer",
     "FilterArtifact",
     "FilterCascade",
+    "FilterKnobs",
+    "ListGroupSource",
+    "PackedGroupSource",
+    "SpillCaptureRing",
     "build_artifact",
+    "build_artifact_from_sources",
     "build_from_aggregator",
     "build_from_merged",
     "canonical_keys",

@@ -34,11 +34,11 @@ docs/FILTER_FORMAT.md; the invariants that matter here:
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import struct
 import tempfile
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -60,6 +60,10 @@ DEFAULT_FP_RATE = 0.01
 
 _jit_cache: dict = {}
 
+# Dispatch statistics of the most recent fused build (None after a
+# per-group build) — a tool/test observability hook, not API.
+LAST_BUILD_STATS = None
+
 
 def _fingerprints_jit():
     fn = _jit_cache.get("fp")
@@ -75,57 +79,51 @@ def _fingerprints_jit():
 
 def canonical_keys(ordinals: np.ndarray, exp_hours: np.ndarray,
                    serials: list[bytes],
-                   use_device: bool | None = None) -> np.ndarray:
+                   use_device: bool | None = None,
+                   chunk: int = 0) -> np.ndarray:
     """uint32[n, 4] canonical filter keys for (ordinal, expHour,
     serial) triples. Conforming serials reuse the pipeline fingerprint
     kernels (device when the batch is large, the vectorized host
     mirror otherwise); oversized serials — host-lane-only identities —
     hash through a disjoint single-purpose encoding that no conforming
     message can collide with (marker byte 0xFF > MAX_SERIAL_BYTES in
-    the length position)."""
+    the length position).
+
+    Chunked driver (round 19): the per-serial message matrix is built
+    ``chunk`` rows at a time (default ``stream.DEFAULT_STREAM_CHUNK``),
+    so only the [n, 4] key array is corpus-sized. Chunk boundaries
+    change no bytes."""
+    from ct_mapreduce_tpu.filter import stream
+
     n = len(serials)
     out = np.zeros((n, 4), np.uint32)
     if n == 0:
         return out
+    chunk = int(chunk) or stream.DEFAULT_STREAM_CHUNK
     ordinals = np.asarray(ordinals, np.int64)
     exp_hours = np.asarray(exp_hours, np.int64)
     lens = np.fromiter((len(s) for s in serials), np.int64, n)
     fit = lens <= packing.MAX_SERIAL_BYTES
     sel = np.nonzero(fit)[0]
-    if sel.size:
-        mat = np.zeros((sel.size, packing.MAX_SERIAL_BYTES), np.uint8)
-        for j, p in enumerate(sel):
-            sb = serials[p]
-            mat[j, : len(sb)] = np.frombuffer(sb, np.uint8)
-        if use_device is None:
-            use_device = device_enabled() and sel.size >= DEVICE_BUILD_MIN
-        if use_device:
-            import jax.numpy as jnp
-
-            with trace.span("filter.fingerprint", cat="filter",
-                            lanes=int(sel.size), device=1):
-                fps = np.asarray(_fingerprints_jit()(
-                    jnp.asarray(ordinals[sel].astype(np.int32)),
-                    jnp.asarray(exp_hours[sel].astype(np.int32)),
-                    jnp.asarray(mat),
-                    jnp.asarray(lens[sel].astype(np.int32)),
-                ))
-        else:
-            fps = packing.fingerprints_np(
-                ordinals[sel], exp_hours[sel], mat, lens[sel])
-        out[sel] = fps
+    for start in range(0, int(sel.size), chunk):
+        part = sel[start: start + chunk]
+        block = [serials[p] for p in part]
+        blens, mat = stream.pack_serials(block)
+        dev = use_device
+        if dev is None:
+            dev = device_enabled() and part.size >= DEVICE_BUILD_MIN
+        with trace.span("filter.stream_chunk", cat="filter",
+                        lanes=int(part.size), device=int(bool(dev))):
+            if dev:
+                fps = stream._fingerprints_device(
+                    ordinals[part], exp_hours[part], mat, blens)
+            else:
+                fps = packing.fingerprints_np(
+                    ordinals[part], exp_hours[part], mat, blens)
+        out[part] = fps
     for p in np.nonzero(~fit)[0]:
-        sb = serials[p]
-        msg = (
-            int(exp_hours[p]).to_bytes(4, "big", signed=True)
-            + int(ordinals[p]).to_bytes(4, "big")
-            + b"\xff"
-            + len(sb).to_bytes(4, "big")
-            + sb
-        )
-        digest = hashlib.sha256(msg).digest()
-        out[p] = [int.from_bytes(digest[16 + 4 * i: 20 + 4 * i], "big")
-                  for i in range(4)]
+        out[p] = stream.oversized_key(
+            int(ordinals[p]), int(exp_hours[p]), serials[p])
     return out
 
 
@@ -257,48 +255,128 @@ class FilterArtifact:
         return FilterArtifact(self.fp_rate, [g]).to_bytes()
 
 
+def fused_enabled() -> bool:
+    """Filter builds use the fused multi-group layer dispatcher by
+    default (round 19); ``CTMR_FILTER_FUSED=0`` forces the round-15
+    per-group path (byte-identical — the parity escape hatch)."""
+    v = os.environ.get("CTMR_FILTER_FUSED", "").strip().lower()
+    if v in ("0", "f", "false"):
+        return False
+    return True
+
+
 def build_artifact(serial_sets: dict, fp_rate: float = DEFAULT_FP_RATE,
-                   use_device: bool | None = None) -> FilterArtifact:
+                   use_device: bool | None = None,
+                   fused: bool | None = None,
+                   stream_chunk: int = 0,
+                   fused_lanes: int = 0) -> FilterArtifact:
     """Compile ``{(issuerID, expHour): iterable of serial bytes}`` into
     a deterministic artifact: each group's cascade includes its own
     serials and excludes every other group's keys."""
+    from ct_mapreduce_tpu.filter import stream
+
+    sources = [stream.ListGroupSource(iss, eh, serial_sets[(iss, eh)])
+               for iss, eh in sorted(serial_sets)]
+    return build_artifact_from_sources(
+        sources, fp_rate=fp_rate, use_device=use_device, fused=fused,
+        stream_chunk=stream_chunk, fused_lanes=fused_lanes)
+
+
+def build_artifact_from_sources(
+        sources: list, fp_rate: float = DEFAULT_FP_RATE,
+        use_device: bool | None = None,
+        fused: bool | None = None,
+        stream_chunk: int = 0,
+        fused_lanes: int = 0) -> FilterArtifact:
+    """The round-19 build driver over :class:`stream.GroupSource`
+    providers (packed chunks — the 10⁸-scale entry point; the dict
+    wrapper above feeds it :class:`stream.ListGroupSource`).
+
+    Serial data streams through the fingerprint kernels in fixed-size
+    blocks, only the ``[N, 4]`` key arena is corpus-resident, and the
+    cascades build through the fused multi-group layer dispatcher (one
+    jitted scatter per layer-round batch, not per (group, layer) —
+    ``fused=False`` / ``CTMR_FILTER_FUSED=0`` for the byte-identical
+    per-group reference path). Streamed, fused, in-memory, and
+    fleet-merged builds of the same logical state produce identical
+    ``CTMRFL01`` bytes (the round-15 contract, property-tested)."""
+    from ct_mapreduce_tpu.filter import fused as fused_mod
+    from ct_mapreduce_tpu.filter import stream
+
+    if fused is None:
+        fused = fused_enabled()
+    stream_chunk = int(stream_chunk) or stream.DEFAULT_STREAM_CHUNK
+    t0 = time.perf_counter()
+    peak_rss = stream._rss_bytes()
     with measure("filter", "build_s"), \
             trace.span("filter.build", cat="filter",
-                       groups=len(serial_sets)):
-        group_keys = sorted(serial_sets)
-        issuers = sorted({iss for iss, _ in group_keys})
+                       groups=len(sources)):
+        sources = sorted(sources, key=lambda s: (s.issuer, s.exp_hour))
+        issuers = sorted({s.issuer for s in sources})
         ordinal = {iss: i for i, iss in enumerate(issuers)}
-        ords, ehs, flat = [], [], []
-        bounds = []
-        for iss, eh in group_keys:
-            serials = sorted(set(serial_sets[(iss, eh)]))
-            start = len(flat)
-            flat.extend(serials)
-            ords.extend([ordinal[iss]] * len(serials))
-            ehs.extend([eh] * len(serials))
-            bounds.append((iss, eh, start, len(flat)))
-        all_keys = canonical_keys(
-            np.asarray(ords, np.int64), np.asarray(ehs, np.int64), flat,
-            use_device=use_device)
-        groups = []
-        for iss, eh, start, end in bounds:
-            if end == start:
+        group_keys = []
+        meta = []
+        for src in sources:
+            if src.n == 0:
                 continue
-            mask = np.zeros((len(flat),), bool)
-            mask[start:end] = True
-            cascade = FilterCascade.build(
-                all_keys[mask], all_keys[~mask], fp_rate,
+            keys = stream.collect_keys(
+                src, ordinal[src.issuer], stream_chunk,
                 use_device=use_device)
+            group_keys.append(keys)
+            meta.append(src)
+            peak_rss = max(peak_rss, stream._rss_bytes())
+        global LAST_BUILD_STATS
+        if fused:
+            cascades, stats = fused_mod.build_cascades_fused(
+                group_keys, fp_rate, use_device=use_device,
+                max_lanes=fused_lanes, consume=True)
+            set_gauge("filter", "fused_groups_per_dispatch",
+                      value=stats.mean_groups_per_dispatch())
+            peak_rss = max(peak_rss, stats.peak_rss)
+            LAST_BUILD_STATS = stats
+        else:
+            cascades = _build_cascades_per_group(
+                group_keys, fp_rate, use_device)
+            LAST_BUILD_STATS = None
+        del group_keys
+        groups = []
+        for src, cascade in zip(meta, cascades):
             groups.append(FilterGroup(
-                issuer=iss, exp_id=ExpDate.from_unix_hour(eh).id(),
-                exp_hour=eh, ordinal=ordinal[iss],
-                n=end - start, cascade=cascade))
+                issuer=src.issuer,
+                exp_id=ExpDate.from_unix_hour(src.exp_hour).id(),
+                exp_hour=src.exp_hour, ordinal=ordinal[src.issuer],
+                n=src.n, cascade=cascade))
         art = FilterArtifact(fp_rate=fp_rate, groups=groups)
+        peak_rss = max(peak_rss, stream._rss_bytes())
+    build_s = time.perf_counter() - t0
     set_gauge("filter", "serials", value=float(art.n_serials))
     set_gauge("filter", "groups", value=float(len(art.groups)))
     set_gauge("filter", "layers", value=float(art.max_layers()))
     set_gauge("filter", "bits_per_entry", value=art.bits_per_entry())
+    set_gauge("filter", "build_rate",
+              value=art.n_serials / max(build_s, 1e-9))
+    set_gauge("filter", "build_rss_bytes", value=float(peak_rss))
     return art
+
+
+def _build_cascades_per_group(group_keys: list, fp_rate: float,
+                              use_device) -> list:
+    """The round-15 reference path: one cascade at a time, each
+    group's excluded universe the concatenation of every other
+    group's keys. Kept as the byte-identity oracle for the fused
+    dispatcher (CTMR_FILTER_FUSED=0 and the parity tests)."""
+    if not group_keys:
+        return []
+    all_keys = np.concatenate(group_keys)
+    bounds = np.cumsum([0] + [k.shape[0] for k in group_keys])
+    cascades = []
+    for i in range(len(group_keys)):
+        mask = np.zeros((all_keys.shape[0],), bool)
+        mask[bounds[i]: bounds[i + 1]] = True
+        cascades.append(FilterCascade.build(
+            all_keys[mask], all_keys[~mask], fp_rate,
+            use_device=use_device))
+    return cascades
 
 
 def capture_by_identity(capture: dict, registry) -> dict:

@@ -54,6 +54,20 @@ DEVICE_BUILD_MIN = 4096
 # fail loudly rather than looping.
 MAX_LAYERS = 64
 
+# Stall escalation (round 19). The double-hash probe mixes the layer
+# index into each key word LINEARLY, so two distinct keys agreeing in
+# the low log2(m) bits of all four words probe the same positions at
+# EVERY level of an m-bit layer — once the chase isolates such a
+# "low-bit twin" pair in the 64-bit tail layers, the alternation
+# ping-pongs forever (first observed at the 10⁶-serial scale legs;
+# round-15 corpora were too small to isolate a pair). When every
+# complement key false-positives on a layer (the stall signature),
+# the layer deterministically rebuilds with doubled m (k recomputed
+# from the same sizing formula) until the twins separate. Readers are
+# unaffected — (m, k) are stored per layer in the artifact — and
+# builds that never stall are byte-identical to round 15.
+MAX_SIZE_ESCALATIONS = 32
+
 
 def device_enabled() -> bool:
     """Filter layers may use the jitted build path (CTMR_FILTER_DEVICE:
@@ -62,6 +76,13 @@ def device_enabled() -> bool:
     if v in ("0", "f", "false"):
         return False
     return True
+
+
+def layer_k(m: int, n: int) -> int:
+    """``k = (m/n) ln 2`` probes clamped to [1, 16] — split out so
+    stall escalation recomputes k from the same formula it grew m
+    under (byte-determinism: one sizing rule everywhere)."""
+    return min(16, max(1, round((m / n) * math.log(2))))
 
 
 def layer_params(n: int, p: float) -> tuple[int, int]:
@@ -75,8 +96,7 @@ def layer_params(n: int, p: float) -> tuple[int, int]:
         raise ValueError("layer over an empty key set")
     m = max(64, math.ceil(-n * math.log(p) / (math.log(2) ** 2)))
     m = ((m + 31) // 32) * 32
-    k = min(16, max(1, round((m / n) * math.log(2))))
-    return m, k
+    return m, layer_k(m, n)
 
 
 def _probe_np(keys: np.ndarray, m: int, k: int, layer: int) -> np.ndarray:
@@ -171,14 +191,33 @@ def layer_contains(words: np.ndarray, m: int, k: int, layer: int,
                    keys: np.ndarray) -> np.ndarray:
     """bool[n]: all ``k`` probe bits set for each key (vectorized
     host probe; the build's false-positive chase and every query path
-    share this one implementation)."""
+    share this one implementation).
+
+    Probes short-circuit (round 19): a lane leaves the working set at
+    its first unset bit, so non-members — the overwhelming majority of
+    the build's complement chase — cost ~1/(1-fill) probes instead of
+    ``k``. Results are bit-identical to probing all ``k``."""
     n = int(keys.shape[0])
     if n == 0:
         return np.zeros((0,), bool)
-    pos = _probe_np(keys, m, k, layer)
+    keys = np.asarray(keys, np.uint32)
+    lay_gold = np.uint32((layer * int(_GOLD)) & 0xFFFFFFFF)
+    lay_mix = np.uint32((layer * int(_MIX)) & 0xFFFFFFFF)
+    a = (keys[:, 0] ^ lay_gold) + keys[:, 2]
+    b = ((keys[:, 1] ^ lay_mix) + keys[:, 3]) | np.uint32(1)
     w = np.asarray(words, np.uint32)
-    bits = (w[pos >> 5] >> (pos & 31).astype(np.uint32)) & 1
-    return bits.all(axis=1)
+    hit = np.ones((n,), bool)
+    alive = np.arange(n, dtype=np.int64)
+    for i in range(k):
+        if alive.size == 0:
+            break
+        pos = ((a[alive] + np.uint32(i) * b[alive])
+               % np.uint32(m)).astype(np.int64)
+        ok = ((w[pos >> 5] >> (pos & 31).astype(np.uint32)) & 1) \
+            .astype(bool)
+        hit[alive[~ok]] = False
+        alive = alive[ok]
+    return hit
 
 
 def _unique_rows(keys: np.ndarray) -> np.ndarray:
@@ -236,10 +275,27 @@ class FilterCascade:
             p = fp_rate if level == 0 else 0.5
             m, k = layer_params(int(cur_in.shape[0]), p)
             words = build_layer(cur_in, m, k, level, use_device=use_device)
-            cascade.layers.append(BloomLayer(m=m, k=k, words=words))
             if cur_out.shape[0] == 0:
+                cascade.layers.append(BloomLayer(m=m, k=k, words=words))
                 break
             hits = layer_contains(words, m, k, level, cur_out)
+            esc = 0
+            while bool(hits.all()):
+                # Stall: every complement key false-positives (low-bit
+                # twins — see MAX_SIZE_ESCALATIONS). Grow the layer
+                # until they separate; identical keys never do.
+                esc += 1
+                if esc > MAX_SIZE_ESCALATIONS:
+                    raise RuntimeError(
+                        "filter cascade stalled: complement keys "
+                        "false-positive at every layer size "
+                        "(non-disjoint inputs?)")
+                m *= 2
+                k = layer_k(m, int(cur_in.shape[0]))
+                words = build_layer(cur_in, m, k, level,
+                                    use_device=use_device)
+                hits = layer_contains(words, m, k, level, cur_out)
+            cascade.layers.append(BloomLayer(m=m, k=k, words=words))
             cur_in, cur_out = cur_out[hits], cur_in
             level += 1
         return cascade

@@ -1,0 +1,234 @@
+"""Streamed canonical-key production for filter builds (round 19).
+
+The round-15 builder materialized, for the whole corpus at once: the
+per-serial Python ``bytes`` lists, one ``uint8[N, MAX_SERIAL_BYTES]``
+message matrix, and the ``uint32[N, 4]`` key array. At 10⁸ serials the
+first two alone are several GB of host RSS before a single layer is
+built. This module bounds that: serial corpora flow as *group sources*
+yielding fixed-size packed chunks, and canonical keys are computed one
+chunk at a time through the jitted fingerprint kernel (or the
+``fingerprints_np`` host mirror) — only the ``[N, 4]`` key arena (16
+bytes/serial) is ever resident for the whole corpus.
+
+Two source flavors:
+
+- :class:`ListGroupSource` wraps the legacy ``{(issuer, expHour):
+  serial iterable}`` shape and owns the round-15 semantics exactly
+  (``sorted(set(serials))`` — the unique count is the group's ``n`` in
+  the artifact header).
+- :class:`PackedGroupSource` feeds pre-packed numpy chunks (length
+  vector + zero-padded message matrix) so a synthetic or spill-drained
+  corpus never mints per-serial Python objects at all. The provider
+  CONTRACT is that serials within a group are unique; duplicates would
+  inflate the header's ``n`` (the bitmap bits themselves are
+  set-determined and immune).
+
+Determinism: keys are a pure function of (ordinal, expHour, serial) —
+chunk boundaries, device-vs-host lanes, and source flavor change no
+bytes (pinned by the round-19 byte-identity property tests).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from ct_mapreduce_tpu.core import packing
+from ct_mapreduce_tpu.telemetry import trace
+
+# Serials per streamed key block. Bounds the transient message matrix
+# (chunk × MAX_SERIAL_BYTES bytes) and keeps the fingerprint kernel's
+# compile shapes fixed; the resolve_filter knob filterStreamChunk /
+# CTMR_FILTER_STREAM_CHUNK overrides. 2^16 measured fastest on the
+# 1-core CI box (the 2^20-wide SHA dispatch is cache-hostile there:
+# ~500K vs ~700K serials/s) and is shape-cheap everywhere.
+DEFAULT_STREAM_CHUNK = 1 << 16
+
+
+def oversized_key(ordinal: int, exp_hour: int, serial: bytes) -> np.ndarray:
+    """The host-lane key for a serial past MAX_SERIAL_BYTES: a disjoint
+    hashlib encoding no conforming fingerprint message can collide with
+    (marker byte 0xFF > MAX_SERIAL_BYTES in the length position)."""
+    msg = (
+        int(exp_hour).to_bytes(4, "big", signed=True)
+        + int(ordinal).to_bytes(4, "big")
+        + b"\xff"
+        + len(serial).to_bytes(4, "big")
+        + serial
+    )
+    digest = hashlib.sha256(msg).digest()
+    return np.array(
+        [int.from_bytes(digest[16 + 4 * i: 20 + 4 * i], "big")
+         for i in range(4)], np.uint32)
+
+
+def pack_serials(serials: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized ``list[bytes]`` → (lens int64[c], mat uint8[c, MAX])
+    for conforming serials (every len ≤ MAX_SERIAL_BYTES). One
+    ``b"".join`` + two scatters instead of a per-serial Python loop."""
+    c = len(serials)
+    mat = np.zeros((c, packing.MAX_SERIAL_BYTES), np.uint8)
+    if c == 0:
+        return np.zeros((0,), np.int64), mat
+    lens = np.fromiter((len(s) for s in serials), np.int64, c)
+    joined = b"".join(serials)
+    if joined:
+        buf = np.frombuffer(joined, np.uint8)
+        row = np.repeat(np.arange(c), lens)
+        offs = np.concatenate(([0], np.cumsum(lens)[:-1]))
+        col = np.arange(buf.size) - np.repeat(offs, lens)
+        mat[row, col] = buf
+    return lens, mat
+
+
+class GroupSource:
+    """One (issuerID, expHour) group's serials as packed chunks.
+
+    ``chunks(chunk_size)`` yields ``(lens, mat, oversized)`` blocks:
+    conforming serials as a packed matrix, oversized ones as raw bytes
+    (the host-lane path). ``n`` is the group's UNIQUE serial count —
+    it lands verbatim in the artifact header."""
+
+    issuer: str
+    exp_hour: int
+    n: int
+
+    def chunks(self, chunk_size: int) -> Iterator[
+            tuple[np.ndarray, np.ndarray, list[bytes]]]:
+        raise NotImplementedError
+
+
+class ListGroupSource(GroupSource):
+    """Legacy serial-iterable shape; dedups at construction (the
+    round-15 ``sorted(set(...))`` semantics — sorting is not needed for
+    the bytes, which are set-determined, but keeps the walk order of
+    the legacy path for debuggability)."""
+
+    def __init__(self, issuer: str, exp_hour: int,
+                 serials: Iterable[bytes]):
+        self.issuer = issuer
+        self.exp_hour = int(exp_hour)
+        self._serials = sorted(set(serials))
+        self.n = len(self._serials)
+
+    def chunks(self, chunk_size: int):
+        for start in range(0, self.n, chunk_size):
+            block = self._serials[start: start + chunk_size]
+            fit = [s for s in block
+                   if len(s) <= packing.MAX_SERIAL_BYTES]
+            oversized = [s for s in block
+                         if len(s) > packing.MAX_SERIAL_BYTES]
+            lens, mat = pack_serials(fit)
+            yield lens, mat, oversized
+
+
+class PackedGroupSource(GroupSource):
+    """Pre-packed chunk provider: ``provider(chunk_size)`` must yield
+    ``(lens, mat, oversized)`` blocks covering exactly ``n`` unique
+    serials. Used by the scale driver (synthetic corpora generated
+    chunk-by-chunk, never resident) and spill-drained captures."""
+
+    def __init__(self, issuer: str, exp_hour: int, n: int, provider):
+        self.issuer = issuer
+        self.exp_hour = int(exp_hour)
+        self.n = int(n)
+        self._provider = provider
+
+    def chunks(self, chunk_size: int):
+        return self._provider(chunk_size)
+
+
+def _rss_bytes() -> int:
+    """Current RSS via /proc (linux; 0 elsewhere). Sampled at chunk
+    and round boundaries by the builders — a sampled peak, honest
+    about missing sub-chunk transients."""
+    try:
+        with open("/proc/self/statm") as fh:
+            return int(fh.read().split()[1]) * 4096
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def key_blocks(source: GroupSource, ordinal: int, chunk_size: int,
+               use_device: Optional[bool] = None
+               ) -> Iterator[np.ndarray]:
+    """Stream one group's canonical keys as ``uint32[c, 4]`` blocks.
+
+    Conforming serials hash through the pipeline fingerprint kernels
+    (device when the block is large, padded to the next power of two
+    so compile shapes stay log-bounded; the ``fingerprints_np`` host
+    mirror otherwise); oversized serials take the disjoint hashlib
+    lane. Block boundaries change no bytes."""
+    from ct_mapreduce_tpu.filter.cascade import (
+        DEVICE_BUILD_MIN,
+        device_enabled,
+    )
+
+    for lens, mat, oversized in source.chunks(chunk_size):
+        c = int(lens.shape[0])
+        out = np.zeros((c + len(oversized), 4), np.uint32)
+        with trace.span("filter.stream_chunk", cat="filter",
+                        lanes=c + len(oversized),
+                        oversized=len(oversized)):
+            if c:
+                dev = use_device
+                if dev is None:
+                    dev = device_enabled() and c >= DEVICE_BUILD_MIN
+                ords = np.full((c,), int(ordinal), np.int64)
+                ehs = np.full((c,), source.exp_hour, np.int64)
+                if dev:
+                    out[:c] = _fingerprints_device(ords, ehs, mat, lens)
+                else:
+                    out[:c] = packing.fingerprints_np(ords, ehs, mat,
+                                                      lens)
+            for j, sb in enumerate(oversized):
+                out[c + j] = oversized_key(ordinal, source.exp_hour, sb)
+        yield out
+
+
+def _fingerprints_device(ords: np.ndarray, ehs: np.ndarray,
+                         mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Jitted fingerprint dispatch, block padded to the next power of
+    two (min 16) — one compile per log bucket, not per ragged block.
+    Padding lanes are sliced off; their garbage rows never escape."""
+    from ct_mapreduce_tpu.filter.artifact import _fingerprints_jit
+
+    import jax.numpy as jnp
+
+    c = int(lens.shape[0])
+    width = max(16, 1 << (c - 1).bit_length())
+    if width != c:
+        pmat = np.zeros((width, mat.shape[1]), np.uint8)
+        pmat[:c] = mat
+        pords = np.zeros((width,), np.int64)
+        pords[:c] = ords
+        pehs = np.zeros((width,), np.int64)
+        pehs[:c] = ehs
+        plens = np.zeros((width,), np.int64)
+        plens[:c] = lens
+        ords, ehs, mat, lens = pords, pehs, pmat, plens
+    fps = np.asarray(_fingerprints_jit()(
+        jnp.asarray(ords.astype(np.int32)),
+        jnp.asarray(ehs.astype(np.int32)),
+        jnp.asarray(mat),
+        jnp.asarray(lens.astype(np.int32)),
+    ))
+    return fps[:c]
+
+
+def collect_keys(source: GroupSource, ordinal: int, chunk_size: int,
+                 use_device: Optional[bool] = None) -> np.ndarray:
+    """All of one group's keys as ``uint32[n, 4]`` — streamed through
+    :func:`key_blocks` so only the key arena is corpus-sized."""
+    out = np.zeros((source.n, 4), np.uint32)
+    pos = 0
+    for block in key_blocks(source, ordinal, chunk_size, use_device):
+        out[pos: pos + block.shape[0]] = block
+        pos += block.shape[0]
+    if pos != source.n:
+        raise ValueError(
+            f"group source ({source.issuer!r}, {source.exp_hour}) "
+            f"yielded {pos} serials, declared n={source.n}")
+    return out

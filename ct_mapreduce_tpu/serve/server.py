@@ -666,7 +666,13 @@ class QueryServer:
                 for name, value in sorted((headers or {}).items()):
                     self.send_header(name, value)
                 self.end_headers()
-                self.wfile.write(payload)
+                # Large-artifact publish path (round 19): a 10⁸-scale
+                # filter is ~100 MB — stream it in 1 MB slices so the
+                # socket layer never buffers a second full copy and
+                # slow clients don't pin one giant write.
+                view = memoryview(payload)
+                for off in range(0, len(view), 1 << 20):
+                    self.wfile.write(view[off: off + (1 << 20)])
                 if code >= 400:
                     incr_counter("serve", "http_errors")
 

@@ -177,6 +177,36 @@ def test_bench_smoke_filter_gate():
 
 
 @pytest.mark.timeout(180)
+def test_bench_smoke_filter_scale_gate():
+    """Scaled filter build leg (ISSUE 14): run_filter_scale_smoke
+    itself gates byte identity across fused/per-group/streamed/NumPy
+    build paths, the fused dispatch collapse, and spill-ring capture
+    parity; here we pin that the leg ran with real work and the
+    BENCHLOG numbers were recorded."""
+    import jax
+
+    if os.environ.get("CT_TPU_TESTS", "") == "":
+        jax.config.update("jax_platforms", "cpu")
+    import bench
+
+    out = bench.run_filter_scale_smoke()  # raises BenchError on a miss
+    assert out["metric"] == "ct_filter_scale_smoke"
+    assert out["value"] > 0
+    assert out["smoke_fscale_serials"] > 30_000
+    assert out["smoke_fscale_groups"] >= 12
+    assert out["smoke_fscale_byte_identity"] == 1
+    # The collapse is the lever: dispatches well under the
+    # per-(group, layer) count the round-15 path would issue.
+    assert out["smoke_fscale_dispatches"] < out["smoke_fscale_layers"]
+    assert out["smoke_fscale_groups_per_dispatch"] > 2.0
+    assert out["smoke_fscale_device_dispatches"] > 0
+    # The spill ring really spilled and changed nothing (parity is
+    # gated inside the leg).
+    assert out["smoke_fscale_spilled_bytes"] > 0
+    assert out["smoke_fscale_spill_segments"] >= 1
+
+
+@pytest.mark.timeout(180)
 def test_bench_smoke_distrib_gate():
     """Distribution leg (ISSUE 13): run_distrib_smoke itself gates
     worker byte-identity (full + containers over HTTP), client-side

@@ -331,19 +331,19 @@ def test_resolve_filter_layering(monkeypatch):
     monkeypatch.delenv("CTMR_EMIT_FILTER", raising=False)
     monkeypatch.delenv("CTMR_FILTER_PATH", raising=False)
     monkeypatch.delenv("CTMR_FILTER_FP_RATE", raising=False)
-    assert resolve_filter(state_path="/x/agg.npz") == \
+    r = resolve_filter(state_path="/x/agg.npz")
+    assert (r.emit, r.path, r.fp_rate) == \
         (False, "/x/agg.npz.filter", 0.01)
     monkeypatch.setenv("CTMR_EMIT_FILTER", "1")
     monkeypatch.setenv("CTMR_FILTER_FP_RATE", "0.05")
-    emit, path, rate = resolve_filter(state_path="/x/agg.npz")
-    assert (emit, rate) == (True, 0.05)
+    r = resolve_filter(state_path="/x/agg.npz")
+    assert (r.emit, r.fp_rate) == (True, 0.05)
     # Explicit values beat env.
-    emit, path, rate = resolve_filter(emit=False, path="/y/f.bin",
-                                      fp_rate=0.2)
-    assert (emit, path, rate) == (False, "/y/f.bin", 0.2)
+    r = resolve_filter(emit=False, path="/y/f.bin", fp_rate=0.2)
+    assert (r.emit, r.path, r.fp_rate) == (False, "/y/f.bin", 0.2)
     # Unparseable env rate falls back to the default.
     monkeypatch.setenv("CTMR_FILTER_FP_RATE", "nope")
-    assert resolve_filter()[2] == 0.01
+    assert resolve_filter().fp_rate == 0.01
 
 
 def test_config_directives(tmp_path):

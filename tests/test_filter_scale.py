@@ -1,0 +1,396 @@
+"""Scaled filter build (round 19): streamed canonical keys, fused
+multi-group layer dispatch, and the memory-bounded capture spill ring.
+
+The headline contract is BYTE IDENTITY: streamed, fused, in-memory,
+fleet-merged, and spill-ring builds of the same logical state must
+produce identical ``CTMRFL01`` artifacts (the round-15 determinism
+contract survives the round-19 rework). Pinned here by a randomized
+property test (oversized host-lane serials and a mid-capture growth
+event included), plus spill-ring crash-restart resume and the new
+resolve_filter knobs.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ct_mapreduce_tpu.agg.aggregator import (  # noqa: E402
+    HostSnapshotAggregator,
+    TpuAggregator,
+)
+from ct_mapreduce_tpu.filter import (  # noqa: E402
+    ListGroupSource,
+    PackedGroupSource,
+    SpillCaptureRing,
+    build_artifact,
+    build_artifact_from_sources,
+    build_from_aggregator,
+    resolve_filter,
+)
+from ct_mapreduce_tpu.filter import fused as fused_mod  # noqa: E402
+from ct_mapreduce_tpu.filter import stream  # noqa: E402
+from ct_mapreduce_tpu.filter.cascade import FilterCascade  # noqa: E402
+from ct_mapreduce_tpu.utils import minicert  # noqa: E402
+
+
+def random_state(rng, n_groups=None, oversized=True):
+    """Randomized {(issuerID, expHour): [serial bytes]} corpora —
+    duplicate serials, shared issuers across expiry buckets, and
+    oversized host-lane serials included."""
+    n_groups = n_groups or int(rng.integers(1, 8))
+    state = {}
+    for g in range(n_groups):
+        iss = f"scale-issuer-{g % max(1, n_groups // 2)}"
+        n = int(rng.integers(1, 300))
+        serials = [rng.integers(0, 256, int(rng.integers(3, 20)),
+                                dtype=np.uint8).tobytes()
+                   for _ in range(n)]
+        if oversized and g % 2 == 0:
+            serials.append(bytes([g]) * 61)  # > MAX_SERIAL_BYTES
+        state[(iss, 500_000 + 24 * g)] = serials + serials[: n // 4]
+    return state
+
+
+# -- byte identity across every build path --------------------------------
+
+
+def test_build_paths_byte_identity_property():
+    """The round-19 acceptance property: for randomized corpora, the
+    fused/streamed builder at several (chunk, lane) shapes equals the
+    round-15 per-group reference path byte for byte."""
+    rng = np.random.default_rng(20260805)
+    for trial in range(4):
+        state = random_state(rng)
+        ref = build_artifact(state, fp_rate=0.02, use_device=False,
+                             fused=False).to_bytes()
+        for kwargs in (dict(), dict(stream_chunk=17),
+                       dict(fused_lanes=64),
+                       dict(stream_chunk=5, fused_lanes=29)):
+            blob = build_artifact(state, fp_rate=0.02,
+                                  use_device=False,
+                                  **kwargs).to_bytes()
+            assert blob == ref, (trial, kwargs)
+
+
+def test_fused_device_lane_byte_identity():
+    """One device leg (small pow2 shapes): the jitted fused scatter
+    and the NumPy lane build the same artifact."""
+    rng = np.random.default_rng(7)
+    state = random_state(rng, n_groups=4)
+    host = build_artifact(state, fp_rate=0.02,
+                          use_device=False).to_bytes()
+    dev = build_artifact(state, fp_rate=0.02, use_device=True,
+                         fused_lanes=128).to_bytes()
+    assert dev == host
+
+
+def test_packed_source_matches_list_source():
+    """A PackedGroupSource feeding pre-packed numpy chunks (the
+    10⁸-scale entry point — no per-serial Python objects) builds the
+    same bytes as the list path, oversized host-lane serials
+    included."""
+    rng = np.random.default_rng(11)
+    state = random_state(rng, n_groups=3)
+    ref = build_artifact(state, fp_rate=0.01,
+                         use_device=False).to_bytes()
+
+    sources = []
+    for (iss, eh), serials in sorted(state.items()):
+        uniq = sorted(set(serials))
+        fit = [s for s in uniq if len(s) <= 46]
+        big = [s for s in uniq if len(s) > 46]
+
+        def provider(chunk_size, fit=fit, big=big):
+            for s0 in range(0, len(fit), chunk_size):
+                block = fit[s0: s0 + chunk_size]
+                lens, mat = stream.pack_serials(block)
+                yield lens, mat, []
+            if big:
+                yield (np.zeros((0,), np.int64),
+                       np.zeros((0, 46), np.uint8), list(big))
+
+        sources.append(PackedGroupSource(iss, eh, len(uniq), provider))
+    blob = build_artifact_from_sources(
+        sources, fp_rate=0.01, use_device=False,
+        stream_chunk=13).to_bytes()
+    assert blob == ref
+
+
+def test_fused_contains_matches_layer_contains():
+    """The fused mixed-group probe equals per-group layer_contains on
+    the same arena (the chase's bit-parity contract)."""
+    from ct_mapreduce_tpu.filter.cascade import (
+        _pack_words,
+        build_layer,
+        layer_contains,
+    )
+
+    rng = np.random.default_rng(3)
+    ms = np.array([1024, 2048, 512], np.int64)
+    ks = np.array([5, 7, 2], np.int64)
+    offs_words = np.concatenate(([0], np.cumsum(ms // 32)[:-1]))
+    keysets = [rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
+               for n in (40, 70, 9)]
+    words = [build_layer(keysets[g], int(ms[g]), int(ks[g]), 2,
+                         use_device=False) for g in range(3)]
+    words_all = np.concatenate(words)
+    probes = [rng.integers(0, 2**32, size=(25, 4), dtype=np.uint32)
+              for _ in range(3)]
+    S = np.concatenate(keysets + probes)
+    chunks = []
+    pos = 0
+    sizes = [k.shape[0] for k in keysets] + [25, 25, 25]
+    spans = []
+    for n in sizes:
+        spans.append(np.arange(pos, pos + n, dtype=np.int64))
+        pos += n
+    chunks = [(0, spans[0]), (1, spans[1]), (2, spans[2]),
+              (0, spans[3]), (1, spans[4]), (2, spans[5])]
+    got = fused_mod.fused_contains(words_all, chunks, S, 2,
+                                   offs_words, ms, ks)
+    for (g, idx), hit in zip(chunks, got):
+        want = layer_contains(words[g], int(ms[g]), int(ks[g]), 2,
+                              S[idx])
+        assert np.array_equal(hit, want), g
+    # Sanity: the layer really contains its own keys.
+    assert got[0].all() and got[1].all() and got[2].all()
+    assert _pack_words is not None
+
+
+def test_fused_dispatch_collapse():
+    """The lever itself: a many-group build issues far fewer scatter
+    dispatches than the per-(group, layer) count, and the
+    groups-per-dispatch stat reflects real packing."""
+    rng = np.random.default_rng(5)
+    groups = [rng.integers(0, 2**32, size=(int(rng.integers(20, 120)), 4),
+                           dtype=np.uint32) for _ in range(12)]
+    cascades, stats = fused_mod.build_cascades_fused(
+        groups, 0.02, use_device=False)
+    assert stats.layers >= 12  # one per group at least
+    assert stats.dispatches < stats.layers
+    assert stats.mean_groups_per_dispatch() > 2.0
+    # And the per-group reference agrees (spot check one group).
+    allk = np.concatenate(groups)
+    bounds = np.cumsum([0] + [g.shape[0] for g in groups])
+    mask = np.zeros(allk.shape[0], bool)
+    mask[bounds[3]: bounds[4]] = True
+    ref = FilterCascade.build(groups[3], allk[~mask], 0.02,
+                              use_device=False)
+    got = cascades[3]
+    assert len(ref.layers) == len(got.layers)
+    for a, b in zip(ref.layers, got.layers):
+        assert (a.m, a.k) == (b.m, b.k)
+        assert np.array_equal(a.words, b.words)
+
+
+# -- spill ring -----------------------------------------------------------
+
+
+def make_ring_state(ring_or_dict, rng, n=300):
+    for j in range(n):
+        key = (int(rng.integers(0, 4)), 500_000 + int(rng.integers(0, 3)))
+        sb = rng.integers(0, 256, int(rng.integers(3, 18)),
+                          dtype=np.uint8).tobytes()
+        if isinstance(ring_or_dict, SpillCaptureRing):
+            ring_or_dict.add(key, sb)
+        else:
+            ring_or_dict.setdefault(key, set()).add(sb)
+
+
+def test_spill_ring_matches_dict_capture(tmp_path):
+    rng1 = np.random.default_rng(17)
+    rng2 = np.random.default_rng(17)
+    ring = SpillCaptureRing(str(tmp_path / "spill"), mem_bytes=2048)
+    plain: dict = {}
+    make_ring_state(ring, rng1)
+    make_ring_state(plain, rng2)
+    assert ring.spilled_bytes > 0  # the tiny budget really spilled
+    assert ring.stats()["segments"] >= 1
+    assert ring.items() == sorted(
+        (k, set(v)) for k, v in plain.items())
+    # Idempotent read; dedup across memory + segments held.
+    assert ring.items() == ring.items()
+
+
+def test_spill_ring_crash_restart_resume(tmp_path):
+    """Durably-flushed segments survive a crash (object dropped
+    without close); a new ring over the same directory resumes with
+    them and keeps appending — no segment number reuse."""
+    spill = str(tmp_path / "spill")
+    ring = SpillCaptureRing(spill, mem_bytes=64)  # spills ~every add
+    for j in range(40):
+        ring.add((1, 500_000), bytes([j]) * 8)
+    flushed = ring.spilled_bytes
+    segs = ring.stats()["segments"]
+    assert segs >= 2
+    pre_crash = {sb for _, s in ring.items() for sb in s}
+    del ring  # crash: in-memory tier lost, segments durable
+
+    back = SpillCaptureRing(spill, mem_bytes=1 << 20)
+    assert back.spilled_bytes == flushed
+    resumed = {sb for _, s in back.items() for sb in s}
+    # Everything durably flushed is back (the unflushed memory tier
+    # re-captures via the resume-at-cursor re-fold in production).
+    assert resumed == pre_crash  # mem was empty at 'crash' (tiny budget)
+    back.add((2, 500_001), b"\xaa" * 9)
+    back.flush()
+    assert back.stats()["segments"] == segs + 1
+    assert ((2, 500_001), {b"\xaa" * 9}) in back.items()
+
+
+def test_aggregator_spill_capture_byte_identity(tmp_path, monkeypatch):
+    """Ingest through a GROWING table with the spill ring on: emitted
+    artifact AND checkpoint filter arrays byte-identical to the
+    in-memory capture of the same corpus."""
+    monkeypatch.setenv("CTMR_TABLE", "bucket")
+    issuer = minicert.make_cert(serial=1, issuer_cn="Spill CA",
+                                is_ca=True)
+
+    def corpus(n, base):
+        ents = [(minicert.make_cert(serial=base + s,
+                                    issuer_cn="Spill CA",
+                                    subject_cn=f"s{s}.example"), issuer)
+                for s in range(n)]
+        return ents + ents[: n // 5]
+
+    aggs = []
+    for spill in (False, True):
+        agg = TpuAggregator(capacity=1 << 8, batch_size=64,
+                            grow_at=0.5, max_capacity=1 << 14)
+        if spill:
+            agg.enable_filter_capture(
+                spill_dir=str(tmp_path / "ring"), spill_mem_bytes=512)
+        else:
+            agg.enable_filter_capture()
+        agg.ingest(corpus(150, 1000))  # growth fires mid-corpus
+        assert agg.capacity > (1 << 8)
+        aggs.append(agg)
+    plain, spilled = aggs
+    assert isinstance(spilled.filter_capture, SpillCaptureRing)
+    assert spilled.filter_capture.spilled_bytes > 0
+    a = build_from_aggregator(plain, fp_rate=0.01).to_bytes()
+    b = build_from_aggregator(spilled, fp_rate=0.01).to_bytes()
+    assert a == b
+    p1, p2 = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+    plain.save_checkpoint(p1)
+    spilled.save_checkpoint(p2)
+    z1 = np.load(p1, allow_pickle=True)
+    z2 = np.load(p2, allow_pickle=True)
+    assert np.array_equal(z1["filter_keys"], z2["filter_keys"])
+    assert list(z1["filter_vals"]) == list(z2["filter_vals"])
+    # The npz round-trips into a dict capture (contract unchanged),
+    # and a restored run can re-arm the ring seeded from it.
+    back = HostSnapshotAggregator(capacity=1 << 10)
+    back.load_checkpoint(p2)
+    assert isinstance(back.filter_capture, dict)
+    back.enable_filter_capture(spill_dir=str(tmp_path / "ring2"),
+                               spill_mem_bytes=256)
+    assert isinstance(back.filter_capture, SpillCaptureRing)
+    assert build_from_aggregator(back, fp_rate=0.01).to_bytes() == a
+
+
+def test_fleet_merged_matches_streamed_spilled(tmp_path):
+    """The four-way acceptance identity: in-memory, streamed, spilled,
+    and fleet-merged builds of the same logical corpus agree."""
+    from ct_mapreduce_tpu.agg import merge
+    from ct_mapreduce_tpu.filter import build_from_merged
+
+    issuer_a = minicert.make_cert(serial=1, issuer_cn="FM CA",
+                                  is_ca=True)
+    issuer_b = minicert.make_cert(serial=2, issuer_cn="FM CA B",
+                                  is_ca=True)
+
+    def corpus(n, cn, issuer, base):
+        return [(minicert.make_cert(serial=base + s, issuer_cn=cn,
+                                    subject_cn=f"m{s}.example"), issuer)
+                for s in range(n)]
+
+    half_a = corpus(45, "FM CA", issuer_a, 1000)
+    half_b = corpus(45, "FM CA B", issuer_b, 600_000)
+    paths = []
+    for w, ents in enumerate((half_b, half_a)):
+        agg = TpuAggregator(capacity=1 << 10, batch_size=64)
+        agg.enable_filter_capture(
+            spill_dir=str(tmp_path / f"ring{w}"), spill_mem_bytes=256)
+        agg.ingest(ents)
+        p = str(tmp_path / f"agg.w{w}.npz")
+        agg.save_checkpoint(p)
+        paths.append(p)
+    serial = TpuAggregator(capacity=1 << 10, batch_size=64)
+    serial.enable_filter_capture()
+    serial.ingest(half_a + half_b)
+
+    merged_blob = build_from_merged(
+        merge.load_checkpoints(paths), fp_rate=0.01).to_bytes()
+    in_mem = build_from_aggregator(serial, fp_rate=0.01).to_bytes()
+    streamed = build_from_aggregator(serial, fp_rate=0.01)  # warm path
+    assert merged_blob == in_mem
+    assert streamed.to_bytes() == in_mem
+
+
+# -- config surface -------------------------------------------------------
+
+
+def test_resolve_filter_scale_knobs(monkeypatch, tmp_path):
+    for env in ("CTMR_FILTER_SPILL_DIR", "CTMR_FILTER_SPILL_MB",
+                "CTMR_FILTER_STREAM_CHUNK", "CTMR_FILTER_FUSED_LANES",
+                "CTMR_PLATFORM_PROFILE"):
+        monkeypatch.delenv(env, raising=False)
+    r = resolve_filter()
+    assert (r.spill_dir, r.spill_mb, r.stream_chunk, r.fused_lanes) \
+        == ("", 256, 0, 0)
+    monkeypatch.setenv("CTMR_FILTER_SPILL_DIR", "/x/ring")
+    monkeypatch.setenv("CTMR_FILTER_SPILL_MB", "64")
+    monkeypatch.setenv("CTMR_FILTER_STREAM_CHUNK", "4096")
+    r = resolve_filter()
+    assert (r.spill_dir, r.spill_mb, r.stream_chunk) \
+        == ("/x/ring", 64, 4096)
+    # Explicit beats env.
+    r = resolve_filter(spill_dir="/y", spill_mb=32, stream_chunk=512,
+                       fused_lanes=2048)
+    assert (r.spill_dir, r.spill_mb, r.stream_chunk, r.fused_lanes) \
+        == ("/y", 32, 512, 2048)
+    # Profile sits under env, above defaults.
+    prof = tmp_path / "prof.json"
+    prof.write_text(json.dumps({
+        "version": 1, "platform": "test",
+        "knobs": {"filter": {"filterCaptureSpillMB": 128,
+                             "filterFusedLanes": 8192}}}))
+    monkeypatch.setenv("CTMR_PLATFORM_PROFILE", str(prof))
+    monkeypatch.delenv("CTMR_FILTER_SPILL_MB", raising=False)
+    r = resolve_filter()
+    assert (r.spill_mb, r.fused_lanes) == (128, 8192)
+
+
+def test_config_scale_directives(tmp_path):
+    from ct_mapreduce_tpu.config import CTConfig
+
+    ini = tmp_path / "f.ini"
+    ini.write_text("filterCaptureSpillDir = /tmp/ring\n"
+                   "filterCaptureSpillMB = 96\n"
+                   "filterStreamChunk = 65536\n"
+                   "filterFusedLanes = 131072\n")
+    cfg = CTConfig.load(["-config", str(ini)], env={})
+    assert cfg.filter_capture_spill_dir == "/tmp/ring"
+    assert cfg.filter_capture_spill_mb == 96
+    assert cfg.filter_stream_chunk == 65536
+    assert cfg.filter_fused_lanes == 131072
+    for d in ("filterCaptureSpillDir", "filterCaptureSpillMB",
+              "filterStreamChunk", "filterFusedLanes"):
+        assert d in cfg.usage()
+
+
+def test_list_source_semantics():
+    src = ListGroupSource("iss", 500_000,
+                          [b"\x02", b"\x01", b"\x02", b"\x61" * 60])
+    assert src.n == 3  # dedup incl. the oversized serial
+    blocks = list(stream.key_blocks(src, 0, 2, use_device=False))
+    total = sum(b.shape[0] for b in blocks)
+    assert total == 3
+    keys = np.concatenate(blocks)
+    assert len({k.tobytes() for k in keys}) == 3

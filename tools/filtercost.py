@@ -101,6 +101,136 @@ def run_point(state: dict, probes: list, rate: float) -> dict:
     }
 
 
+def packed_sources(n_serials: int, n_groups: int, seed: int = 7,
+                   serial_bytes: int = 16, epoch_extra: int = 0,
+                   churn_groups: int = 1):
+    """Scale-leg corpora as PackedGroupSources: serials are an 8-byte
+    big-endian per-group counter (unique BY CONSTRUCTION) followed by
+    deterministic pseudo-random tail bytes, generated chunk by chunk —
+    no per-serial Python objects, nothing corpus-sized resident.
+    ``epoch_extra`` appends that many further serials to each of the
+    first ``churn_groups`` groups (the delta leg's epoch-2 corpus:
+    epoch 1 plus growth concentrated where churn really lands —
+    untouched groups must cost zero delta bytes)."""
+    from ct_mapreduce_tpu.filter import PackedGroupSource
+
+    base_per = max(1, n_serials // n_groups)
+    sources = []
+    for g in range(n_groups):
+        per = base_per + (epoch_extra if g < churn_groups else 0)
+
+        def provider(chunk_size, g=g, per=per):
+            import numpy as np
+
+            for start in range(0, per, chunk_size):
+                c = min(chunk_size, per - start)
+                lens = np.full((c,), serial_bytes, np.int64)
+                mat = np.zeros((c, 46), np.uint8)
+                idx = start + np.arange(c, dtype=np.uint64)
+                shifts = np.arange(7, -1, -1, dtype=np.uint64) * \
+                    np.uint64(8)
+                mat[:, :8] = ((idx[:, None] >> shifts[None, :])
+                              & np.uint64(0xFF)).astype(np.uint8)
+                # Pseudo-random tail as a pure function of the serial
+                # INDEX (splitmix64), so the corpus is identical at
+                # every chunk size — chunk boundaries must not change
+                # the bytes the build sees.
+                x = (idx ^ (np.uint64(seed) * np.uint64(0x100000001))
+                     ^ (np.uint64(g) << np.uint64(40)))
+                x = (x + np.uint64(0x9E3779B97F4A7C15))
+                x ^= x >> np.uint64(30)
+                x = x * np.uint64(0xBF58476D1CE4E5B9)
+                x ^= x >> np.uint64(27)
+                x = x * np.uint64(0x94D049BB133111EB)
+                x ^= x >> np.uint64(31)
+                mat[:, 8:serial_bytes] = (
+                    (x[:, None] >> shifts[None, :8])
+                    & np.uint64(0xFF)).astype(np.uint8)[
+                        :, : serial_bytes - 8]
+                yield lens, mat, []
+
+        sources.append(PackedGroupSource(
+            f"scale-issuer-{g % max(1, n_groups // 2)}",
+            500_000 + 24 * g, per, provider))
+    return sources
+
+
+def run_scale_leg(n: int, n_groups: int, rate: float, seed: int,
+                  fused: bool = True, use_device=None,
+                  stream_chunk: int = 0) -> tuple[dict, bytes]:
+    """One scale leg: packed corpus → artifact; serials/s, sampled
+    peak RSS, and the layer/dispatch collapse."""
+    import time as _time
+
+    from ct_mapreduce_tpu.filter import artifact as fartifact
+    from ct_mapreduce_tpu.telemetry.metrics import get_sink
+
+    sources = packed_sources(n, n_groups, seed=seed)
+    t0 = time.perf_counter()
+    art = fartifact.build_artifact_from_sources(
+        sources, fp_rate=rate, fused=fused, use_device=use_device,
+        stream_chunk=stream_chunk)
+    build_s = time.perf_counter() - t0
+    gauges = get_sink().snapshot().get("gauges", {})
+    stats = fartifact.LAST_BUILD_STATS
+    blob = art.to_bytes()
+    point = {
+        "metric": "ct_filter_scale",
+        "serials": art.n_serials,
+        "groups": len(art.groups),
+        "fused": bool(fused),
+        "build_s": round(build_s, 2),
+        "serials_per_s": round(art.n_serials / max(build_s, 1e-9), 1),
+        "peak_rss_bytes": int(gauges.get("filter.build_rss_bytes", 0)),
+        "artifact_bytes": len(blob),
+        "bits_per_entry": round(art.bits_per_entry(), 3),
+        "max_layers": art.max_layers(),
+        "layers_total": (stats.layers if stats else
+                         sum(len(g.cascade.layers)
+                             for g in art.groups.values())),
+        "scatter_dispatches": stats.dispatches if stats else None,
+        "layer_rounds": stats.rounds if stats else None,
+        "groups_per_dispatch": (
+            round(stats.mean_groups_per_dispatch(), 2)
+            if stats else None),
+        "wall_clock": _time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    return point, blob
+
+
+def run_delta_leg(n: int, n_groups: int, rate: float, seed: int,
+                  base_blob: bytes, churn: int) -> dict:
+    """CTMRDL01 bits-on-wire at scale (ROADMAP 4(b) residue): epoch 2
+    = epoch 1 + ``churn`` serials in ONE group (churn is localized —
+    the other groups must contribute zero delta payload); measure the
+    delta link (raw + gzip) against the full artifact pull."""
+    import gzip
+
+    from ct_mapreduce_tpu.distrib import delta as delta_mod
+    from ct_mapreduce_tpu.filter import artifact as fartifact
+
+    sources = packed_sources(n, n_groups, seed=seed, epoch_extra=churn)
+    art2 = fartifact.build_artifact_from_sources(sources, fp_rate=rate)
+    blob2 = art2.to_bytes()
+    link = delta_mod.compute_delta(base_blob, blob2, 1, 2)
+    replay = delta_mod.apply_delta(base_blob, link)
+    assert replay == blob2, "delta replay mismatch at scale"
+    gz = lambda b: len(gzip.compress(b, mtime=0))  # noqa: E731
+    return {
+        "metric": "ct_filter_scale_delta",
+        "serials": art2.n_serials,
+        "churn_serials": churn,
+        "churn_groups": 1,
+        "full_bytes": len(blob2),
+        "delta_bytes": len(link),
+        "delta_vs_full": round(len(link) / max(1, len(blob2)), 6),
+        "full_gzip_bytes": gz(blob2),
+        "delta_gzip_bytes": gz(link),
+        "delta_vs_full_gzip": round(
+            gz(link) / max(1, gz(blob2)), 6),
+    }
+
+
 def main(argv=None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     ap = argparse.ArgumentParser(description=__doc__)
@@ -109,7 +239,46 @@ def main(argv=None) -> int:
     ap.add_argument("--probes", type=int, default=20000)
     ap.add_argument("--rates", default="0.5,0.1,0.01,0.001")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--scale", default="",
+                    help="comma list of corpus sizes (e.g. 1e6,1e7,1e8)"
+                         ": run the round-19 scaled-build legs instead "
+                         "of the rate sweep")
+    ap.add_argument("--scale-rate", type=float, default=0.01)
+    ap.add_argument("--legacy", action="store_true",
+                    help="also run each scale leg through the "
+                         "per-group (round-15) build path")
+    ap.add_argument("--host-lane", action="store_true",
+                    help="force the NumPy build lane "
+                         "(CTMR_FILTER_DEVICE=0 equivalent)")
+    ap.add_argument("--delta", type=int, default=0, metavar="CHURN",
+                    help="after each scale leg, measure the CTMRDL01 "
+                         "delta for an epoch adding CHURN serials per "
+                         "group")
     args = ap.parse_args(argv)
+
+    if args.scale:
+        use_device = False if args.host_lane else None
+        rc = 0
+        for spec in (s for s in args.scale.split(",") if s):
+            n = int(float(spec))
+            point, blob = run_scale_leg(
+                n, args.groups, args.scale_rate, args.seed,
+                use_device=use_device)
+            print(json.dumps(point), flush=True)
+            if args.legacy:
+                lpoint, lblob = run_scale_leg(
+                    n, args.groups, args.scale_rate, args.seed,
+                    fused=False, use_device=use_device)
+                print(json.dumps(lpoint), flush=True)
+                if lblob != blob:
+                    print(f"BYTE MISMATCH fused vs legacy at n={n}",
+                          file=sys.stderr)
+                    rc = 1
+            if args.delta:
+                print(json.dumps(run_delta_leg(
+                    n, args.groups, args.scale_rate, args.seed, blob,
+                    args.delta)), flush=True)
+        return rc
 
     state = synth_state(args.serials, args.groups, seed=args.seed)
     probes = synth_probes(args.probes, seed=args.seed + 4)

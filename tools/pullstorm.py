@@ -129,9 +129,13 @@ def run_storm(clients: int = 10_000, epochs: int = 5, groups: int = 40,
               threads: int = 32, max_chain: int = 4,
               cold_fraction: float = 0.05,
               container_fraction: float = 0.2, zipf_a: float = 1.6,
-              seed: int = 20260805, validate_every: int = 50) -> dict:
+              seed: int = 20260805, validate_every: int = 50,
+              force_zstd: bool = False) -> dict:
     """The full storm. Returns the report dict (also printed as JSON
-    by the CLI)."""
+    by the CLI). ``force_zstd`` makes every compressible pull demand
+    zstd and fails loudly when the fleet can't serve it (validating
+    the zstd wire leg — ROADMAP item 4(c); needs the optional
+    ``zstandard`` module on BOTH ends)."""
     from ct_mapreduce_tpu.distrib import (
         ChainManifest,
         apply_chain,
@@ -148,6 +152,30 @@ def run_storm(clients: int = 10_000, epochs: int = 5, groups: int = 40,
         manifest = ChainManifest.from_json(man)
         latest_etag = sizes["etag"]
         full_size = sizes["full"]
+
+        if force_zstd:
+            from ct_mapreduce_tpu.distrib.publish import zstd_available
+
+            if "zstd" not in man["encodings"] or not zstd_available():
+                raise RuntimeError(
+                    "--force-zstd: the fleet does not advertise zstd "
+                    "(install the optional `zstandard` module)")
+            import zstandard as _zstd_mod
+
+            accept = "zstd"
+
+            def _decode(body: bytes, encoding) -> bytes:
+                if encoding != "zstd":
+                    raise RuntimeError(
+                        f"--force-zstd: server answered "
+                        f"Content-Encoding={encoding!r}, wanted zstd")
+                return _zstd_mod.ZstdDecompressor().decompress(body)
+        else:
+            accept = "gzip"
+
+            def _decode(body: bytes, encoding) -> bytes:
+                return (gzip.decompress(body) if encoding == "gzip"
+                        else body)
 
         # Client plan: zipf epoch lag (0 = warm), a cold slice, a
         # container-pulling slice of the colds.
@@ -175,11 +203,11 @@ def run_storm(clients: int = 10_000, epochs: int = 5, groups: int = 40,
                     return "container", len(r.read()), t0
                 req = urllib.request.Request(
                     base + "/filter",
-                    headers={"Accept-Encoding": "gzip"})
+                    headers={"Accept-Encoding": accept})
                 r = urllib.request.urlopen(req)
                 body = r.read()
-                if r.headers.get("Content-Encoding") == "gzip":
-                    gzip.decompress(body)  # client really can use it
+                # Client really can use the negotiated encoding.
+                _decode(body, r.headers.get("Content-Encoding"))
                 return "full", len(body), t0
             lag = int(lags[i])
             if lag == 0:
@@ -197,12 +225,11 @@ def run_storm(clients: int = 10_000, epochs: int = 5, groups: int = 40,
             try:
                 req = urllib.request.Request(
                     f"{base}/filter/delta/{latest - lag}/{latest}",
-                    headers={"Accept-Encoding": "gzip"})
+                    headers={"Accept-Encoding": accept})
                 r = urllib.request.urlopen(req)
                 wire = r.read()
-                bundle = (gzip.decompress(wire)
-                          if r.headers.get("Content-Encoding") == "gzip"
-                          else wire)
+                bundle = _decode(wire,
+                                 r.headers.get("Content-Encoding"))
             except urllib.error.HTTPError as err:
                 if err.code != 404:
                     raise
@@ -302,6 +329,10 @@ def main(argv=None) -> int:
     p.add_argument("--containers", type=float, default=0.2)
     p.add_argument("--zipf", type=float, default=1.6)
     p.add_argument("--seed", type=int, default=20260805)
+    p.add_argument("--force-zstd", action="store_true",
+                   help="every compressible pull demands zstd; fails "
+                        "when the optional zstandard module is absent "
+                        "(validates the zstd wire leg)")
     args = p.parse_args(argv)
     report = run_storm(
         clients=args.clients, epochs=args.epochs, groups=args.groups,
@@ -309,7 +340,7 @@ def main(argv=None) -> int:
         workers=args.workers, threads=args.threads,
         max_chain=args.max_chain, cold_fraction=args.cold,
         container_fraction=args.containers, zipf_a=args.zipf,
-        seed=args.seed)
+        seed=args.seed, force_zstd=args.force_zstd)
     print(json.dumps(report, indent=2))
     return 0
 
