@@ -53,6 +53,7 @@ import numpy as np
 from ct_mapreduce_tpu.core import der as hostder
 from ct_mapreduce_tpu.core import packing
 from ct_mapreduce_tpu.core.types import ExpDate, Issuer
+from ct_mapreduce_tpu.filter.cache import content_token, serial_hash
 from ct_mapreduce_tpu.filter.spill import SpillCaptureRing
 from ct_mapreduce_tpu.ops import buckettable, der_kernel, hashtable, pipeline
 from ct_mapreduce_tpu.telemetry import trace
@@ -551,10 +552,22 @@ class TpuAggregator:
         # zero overhead and byte-identical checkpoints.
         self.filter_capture: Optional[dict[tuple[int, int],
                                            set[bytes]]] = None
+        # Exact per-group XOR content hashes for the dict capture
+        # (CTMRFL02 dirty tracking): maintained incrementally alongside
+        # first-seen capture; None when capture is off, the ring owns
+        # its own hashes, or exactness was lost (restored snapshot
+        # without stored hashes). A missing/None value only costs a
+        # token recomputation — never a wrong reuse.
+        self.filter_capture_hashes: Optional[dict[tuple[int, int],
+                                                  int]] = None
         # Checkpoint-time filter emission (configure_filter_emission):
         # empty path = no artifact written.
         self.emit_filter_path = ""
         self.filter_fp_rate = 0.01
+        self.filter_fmt = ""  # "" = resolve_format default
+        # Checkpoint-time incremental build cache (CTMRFL02): clean
+        # groups' cascades carry over between emissions.
+        self._filter_build_cache = None
         self.set_cn_prefixes(cn_prefixes)
         self.metrics: dict[str, int] = {
             "inserted": 0, "known": 0, "filtered_ca": 0, "filtered_expired": 0,
@@ -827,10 +840,16 @@ class TpuAggregator:
             for key, serials in sorted(seed.items()):
                 ring.update(key, sorted(serials))
             self.filter_capture = ring
+            # The ring owns content-hash tracking from here on.
+            self.filter_capture_hashes = None
         if self.filter_capture is None:
             self.filter_capture = {
                 key: set(serials)
                 for key, serials in self.host_serials.items()
+            }
+            self.filter_capture_hashes = {
+                key: content_token(serials)[1]
+                for key, serials in self.filter_capture.items()
             }
             if self._device_written and self._table_fill_exact() > 0:
                 print(
@@ -844,12 +863,15 @@ class TpuAggregator:
     def configure_filter_emission(self, path: str,
                                   fp_rate: float = 0.01,
                                   spill_dir: str = "",
-                                  spill_mem_bytes: int = 0) -> None:
+                                  spill_mem_bytes: int = 0,
+                                  fmt: str = "") -> None:
         """Emit a filter artifact (``path``) on every checkpoint save,
-        compiled from the capture at the target FP rate."""
+        compiled from the capture at the target FP rate. ``fmt`` picks
+        the artifact format ("" → the CTMR_FILTER_FORMAT default)."""
         self.emit_filter_path = path
         if fp_rate > 0:
             self.filter_fp_rate = float(fp_rate)
+        self.filter_fmt = fmt or ""
         self.enable_filter_capture(spill_dir=spill_dir,
                                    spill_mem_bytes=spill_mem_bytes)
 
@@ -863,7 +885,27 @@ class TpuAggregator:
         if isinstance(cap, SpillCaptureRing):
             cap.add((issuer_idx, exp_hour), serial)
         else:
-            cap.setdefault((issuer_idx, exp_hour), set()).add(serial)
+            key = (issuer_idx, exp_hour)
+            s = cap.setdefault(key, set())
+            if serial not in s:
+                s.add(serial)
+                h = self.filter_capture_hashes
+                if h is not None:
+                    h[key] = h.get(key, 0) ^ serial_hash(serial)
+
+    def capture_content_hashes(self) -> Optional[dict]:
+        """Exact per-(issuer_idx, expHour) XOR content hashes of the
+        filter capture, or None when unavailable (capture off, spilled
+        ring, or a restored snapshot that predates hash tracking).
+        Callers hold the fold lock, as for the capture itself."""
+        cap = self.filter_capture
+        if cap is None:
+            return None
+        if isinstance(cap, SpillCaptureRing):
+            return cap.content_hashes()
+        if self.filter_capture_hashes is None:
+            return None
+        return dict(self.filter_capture_hashes)
 
     # -- ingest ----------------------------------------------------------
     def ingest(self, entries: list[tuple[bytes, bytes]]) -> IngestResult:
@@ -1814,10 +1856,15 @@ class TpuAggregator:
         poison the checkpoint that already landed — it is reported and
         counted, and the next checkpoint retries."""
         from ct_mapreduce_tpu.filter import artifact as fartifact
+        from ct_mapreduce_tpu.filter.cache import GroupBuildCache
 
         try:
+            if self._filter_build_cache is None:
+                self._filter_build_cache = GroupBuildCache()
             art = fartifact.build_from_aggregator(
-                self, fp_rate=self.filter_fp_rate)
+                self, fp_rate=self.filter_fp_rate,
+                fmt=self.filter_fmt or None,
+                cache=self._filter_build_cache)
             fartifact.write_artifact(self.emit_filter_path, art.to_bytes())
         except Exception as err:
             incr_counter("filter", "emit_error")
@@ -1860,6 +1907,16 @@ class TpuAggregator:
             ).reshape(-1, 2)
             extra["filter_vals"] = np.array(
                 [v for _, _, v in f_items], dtype=object)
+            # Exact content hashes ride along when the capture layer
+            # has them (row-aligned with filter_keys) so a restored
+            # run resumes incremental dirty tracking without an
+            # O(capture) rehash. Absent when exactness was lost (e.g.
+            # a spilled ring) — restore recomputes instead.
+            hashes = self.capture_content_hashes()
+            if hashes is not None:
+                extra["filter_hashes"] = np.array(
+                    [format(hashes.get((i, e), 0), "032x").encode()
+                     for i, e, _ in f_items], dtype=object)
         np.savez_compressed(
             fh,
             # (keys, meta, count) stays the cross-version wire format;
@@ -1998,6 +2055,7 @@ class TpuAggregator:
         # emitFilter-off writer) → capture stays off; a later
         # enable_filter_capture() re-seeds from the restored host sets.
         self.filter_capture = None
+        self.filter_capture_hashes = None
         if "filter_keys" in z:
             cap: dict[tuple[int, int], set[bytes]] = {}
             for (idx, eh), blob in zip(
@@ -2006,6 +2064,22 @@ class TpuAggregator:
                     bytes.fromhex(h.decode()) for h in blob.split(b";") if h
                 }
             self.filter_capture = cap
+            if "filter_hashes" in z:
+                self.filter_capture_hashes = {
+                    (int(idx), int(eh)): int(hx.decode(), 16)
+                    for (idx, eh), hx in zip(
+                        z["filter_keys"].reshape(-1, 2),
+                        z["filter_hashes"])
+                }
+            else:
+                # Pre-hash snapshot (or a writer whose ring had lost
+                # exactness): the restored dict IS the full content,
+                # so recomputing here regains exact incremental
+                # tracking for the rest of the run.
+                self.filter_capture_hashes = {
+                    key: content_token(serials)[1]
+                    for key, serials in cap.items()
+                }
             self.want_serials = True
 
 

@@ -47,6 +47,10 @@ SCOPE_MODULES: tuple[str, ...] = (
     "ct_mapreduce_tpu/filter/stream.py",
     "ct_mapreduce_tpu/filter/fused.py",
     "ct_mapreduce_tpu/filter/spill.py",
+    # Round 20 — the dirty-group build cache decides which groups are
+    # rebuilt vs reused verbatim; a hash-order walk here would make
+    # "identical corpus" produce different artifact bytes per process.
+    "ct_mapreduce_tpu/filter/cache.py",
 )
 
 # (module pattern, function name): serialization paths inside

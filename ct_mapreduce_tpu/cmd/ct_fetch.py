@@ -254,7 +254,8 @@ def main(argv: list[str] | None = None) -> int:
         spill_dir=config.filter_capture_spill_dir,
         spill_mb=config.filter_capture_spill_mb,
         stream_chunk=config.filter_stream_chunk,
-        fused_lanes=config.filter_fused_lanes)
+        fused_lanes=config.filter_fused_lanes,
+        fmt=config.filter_format)
     emit_filter, base_filter_path, filter_fp = (
         fknobs.emit, fknobs.path, fknobs.fp_rate)
     if emit_filter and model is not None:
@@ -265,11 +266,19 @@ def main(argv: list[str] | None = None) -> int:
             spill_dir=(worker_state_path(fknobs.spill_dir,
                                          fleet_worker_id, num_workers)
                        if fknobs.spill_dir else ""),
-            spill_mem_bytes=fknobs.spill_mb << 20)
+            spill_mem_bytes=fknobs.spill_mb << 20,
+            fmt=fknobs.fmt)
     elif emit_filter:
         print("emitFilter ignored: filter emission needs backend = tpu",
               file=sys.stderr)
         emit_filter = False
+
+    # Leader-side incremental build cache: across epoch ticks only
+    # churned groups of the merged fleet filter rebuild (tokens always
+    # recompute from the merged union sets — never worker hashes).
+    from ct_mapreduce_tpu.filter import GroupBuildCache
+
+    fleet_filter_cache = GroupBuildCache()
 
     def leader_fleet_filter() -> None:
         """Leader epoch-tick duty: fold every worker snapshot present
@@ -294,7 +303,8 @@ def main(argv: list[str] | None = None) -> int:
         try:
             merged = aggmerge.load_checkpoints(paths)
             art = fartifact.build_from_merged(
-                merged, fp_rate=filter_fp, allow_partial=True)
+                merged, fp_rate=filter_fp, allow_partial=True,
+                fmt=fknobs.fmt, cache=fleet_filter_cache)
             fartifact.write_artifact(base_filter_path, art.to_bytes())
             incr_counter("filter", "fleet_emit")
         except Exception as err:

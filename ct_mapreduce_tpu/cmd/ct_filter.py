@@ -5,7 +5,7 @@ The CLI face of :mod:`ct_mapreduce_tpu.filter` (round 15) and the
 distribution plane (round 18):
 
     ct-filter build -state agg.npz[,agg.w*.npz] -out run.filter \\
-              [-fpRate 0.01] [-allowPartial]
+              [-fpRate 0.01] [-format fl01|fl02] [-allowPartial]
     ct-filter inspect -artifact run.filter [-json]
     ct-filter query -artifact run.filter -issuer <issuerID> \\
               -expDate 2031-06-15-14 -serial 4d0000002a [-serial ...]
@@ -16,8 +16,12 @@ distribution plane (round 18):
     ct-filter container -artifact run.filter -kind mlbf|clubcard \\
               -out run.mlbf
 
-``delta`` computes the versioned ``CTMRDL01`` stash/diff between two
-epochs' artifacts; ``apply`` replays one or more delta links (bundles
+``build -format`` picks the artifact format: ``fl02`` (default —
+per-group universes, ``CTMRFL02``) or ``fl01`` (the global-universe
+compatibility path). ``delta`` computes the versioned stash/diff
+between two epochs' artifacts — ``CTMRDL01`` or ``CTMRDL02`` follows
+the endpoints' artifact format automatically (mixed endpoints are
+refused); ``apply`` replays one or more delta links (bundles
 split automatically) and writes bytes guaranteed identical to the
 full build (the per-link SHA-256 checks fail loudly otherwise);
 ``container`` re-encodes an artifact into an upstream
@@ -63,7 +67,8 @@ def _build(args, out) -> int:
         return 2
     try:
         art = build_from_merged(merged, fp_rate=args.fpRate,
-                                allow_partial=args.allowPartial)
+                                allow_partial=args.allowPartial,
+                                fmt=args.format or None)
     except ValueError as err:
         print(f"error: {err}", file=sys.stderr)
         return 2
@@ -73,6 +78,7 @@ def _build(args, out) -> int:
         "out": args.out,
         "bytes": len(blob),
         "checkpoints": paths,
+        "format": art.fmt,
         "serials": art.n_serials,
         "groups": len(art.groups),
         "max_layers": art.max_layers(),
@@ -98,6 +104,7 @@ def _inspect(args, out) -> int:
         for _, g in sorted(art.groups.items())
     ]
     body = {
+        "format": art.fmt,
         "fp_rate": art.fp_rate,
         "serials": art.n_serials,
         "groups": len(groups),
@@ -207,6 +214,10 @@ def main(argv: list[str] | None = None, out=None) -> int:
     b.add_argument("-allowPartial", "--allowPartial", action="store_true",
                    help="accept checkpoints without a filter capture "
                         "(their device-lane serials will be missing)")
+    b.add_argument("-format", "--format", default="",
+                   choices=("", "fl01", "fl02"),
+                   help="artifact format (default: the "
+                        "CTMR_FILTER_FORMAT ladder, fl02)")
 
     i = sub.add_parser("inspect", help="artifact → structure summary")
     i.add_argument("-artifact", "--artifact", required=True)
@@ -222,7 +233,9 @@ def main(argv: list[str] | None = None, out=None) -> int:
     q.add_argument("-serial", "--serial", action="append", default=[],
                    help="serial content bytes as hex (repeatable)")
 
-    d = sub.add_parser("delta", help="CTMRDL01 diff between epochs")
+    d = sub.add_parser("delta",
+                       help="CTMRDL01/CTMRDL02 diff between epochs "
+                            "(magic follows the artifacts' format)")
     d.add_argument("-base", "--base", required=True,
                    help="the from-epoch full artifact")
     d.add_argument("-target", "--target", required=True,

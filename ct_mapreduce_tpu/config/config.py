@@ -120,6 +120,8 @@ class CTConfig:
     # the filter build (0 = CTMR_FILTER_STREAM_CHUNK env, then 2^16)
     filter_fused_lanes: int = 0  # lanes per fused filter-build scatter
     # dispatch (0 = CTMR_FILTER_FUSED_LANES env, then 2^20)
+    filter_format: str = ""  # artifact format, "fl01" | "fl02"
+    # ("" = CTMR_FILTER_FORMAT env, then fl02 — round 20)
     platform_profile: str = ""  # tuned-knob profile JSON (one loader
     # for every subsystem's resolve_*; "" = CTMR_PLATFORM_PROFILE env)
     distrib_history: int = 0  # filter-distribution epochs held per
@@ -185,6 +187,7 @@ class CTConfig:
         "filterCaptureSpillMB": ("filter_capture_spill_mb", int),
         "filterStreamChunk": ("filter_stream_chunk", int),
         "filterFusedLanes": ("filter_fused_lanes", int),
+        "filterFormat": ("filter_format", str),
         "platformProfile": ("platform_profile", str),
         "distribHistory": ("distrib_history", int),
         "maxDeltaChain": ("max_delta_chain", int),
@@ -416,6 +419,10 @@ class CTConfig:
             "dispatch (CTMR_FILTER_FUSED_LANES equivalent; default "
             "2^20; CTMR_FILTER_FUSED=0 forces the per-group build "
             "path — byte-identical)",
+            "filterFormat = filter artifact format, fl01 | fl02 "
+            "(CTMR_FILTER_FORMAT equivalent; default fl02 — per-group "
+            "universes: decoupled deltas + dirty-group incremental "
+            "rebuilds; fl01 is the global-universe compatibility path)",
             "platformProfile = tuned-knob profile JSON file "
             "(CTMR_PLATFORM_PROFILE equivalent): one loader feeds "
             "every subsystem's knob resolution, so a tuned device "

@@ -20,6 +20,14 @@ every membership answer is identical to the source's (pinned by
 tests/test_distrib.py). Encodings are deterministic — groups iterate
 sorted, no wall-clock — so a container's bytes (and therefore its
 ETag) are byte-identical on every worker of a fleet.
+
+A container sourced from a ``CTMRFL02`` artifact writes the rev-2
+magics (``CTMRMB02`` / ``CTMRCC02``): the record layout is unchanged,
+but the per-group cascades were built against per-group universes, so
+a consumer must know which native format a decoded artifact
+re-serializes to (and which FP semantics apply to unobserved groups —
+docs/FILTER_FORMAT.md). ``decode_container`` restores the matching
+``fmt`` on the artifact it returns.
 """
 
 from __future__ import annotations
@@ -28,12 +36,28 @@ import struct
 
 import numpy as np
 
-from ct_mapreduce_tpu.filter.artifact import FilterArtifact, FilterGroup
+from ct_mapreduce_tpu.filter.artifact import (
+    FORMAT_FL01,
+    FORMAT_FL02,
+    FilterArtifact,
+    FilterGroup,
+)
 from ct_mapreduce_tpu.filter.cascade import BloomLayer, FilterCascade
 from ct_mapreduce_tpu.telemetry.metrics import measure
 
 MLBF_MAGIC = b"CTMRMB01"
+MLBF_MAGIC2 = b"CTMRMB02"
 CLUBCARD_MAGIC = b"CTMRCC01"
+CLUBCARD_MAGIC2 = b"CTMRCC02"
+
+# Source artifact format → container magic (and back). Layouts are
+# identical across revs; the magic records the provenance format.
+_MLBF_MAGIC_BY_FMT = {FORMAT_FL01: MLBF_MAGIC, FORMAT_FL02: MLBF_MAGIC2}
+_CLUB_MAGIC_BY_FMT = {FORMAT_FL01: CLUBCARD_MAGIC,
+                      FORMAT_FL02: CLUBCARD_MAGIC2}
+_FMT_BY_MAGIC = {MLBF_MAGIC: FORMAT_FL01, MLBF_MAGIC2: FORMAT_FL02,
+                 CLUBCARD_MAGIC: FORMAT_FL01,
+                 CLUBCARD_MAGIC2: FORMAT_FL02}
 # Hash-algorithm tag: 1 = the pipeline's Kirsch-Mitzenmacher double
 # hash over SHA-256 fingerprint words (docs/FILTER_FORMAT.md). The
 # only algorithm this build writes; readers must reject others.
@@ -95,7 +119,7 @@ def encode_mlbf(art: FilterArtifact) -> bytes:
     u32 n ‖ u8 nLayers ‖ per layer u32 m ‖ u8 k ‖ u32 nWords ‖
     little-endian uint32 bitmap words."""
     with measure("distrib", "container_build_s"):
-        out = bytearray(MLBF_MAGIC)
+        out = bytearray(_MLBF_MAGIC_BY_FMT[art.fmt])
         out += struct.pack("<Bd", HASH_ALG_KM_SHA256, art.fp_rate)
         out += struct.pack("<I", len(art.groups))
         for (_, _), g in sorted(art.groups.items()):
@@ -112,7 +136,7 @@ def encode_mlbf(art: FilterArtifact) -> bytes:
 
 
 def decode_mlbf(blob: bytes) -> FilterArtifact:
-    if blob[:8] != MLBF_MAGIC:
+    if blob[:8] not in (MLBF_MAGIC, MLBF_MAGIC2):
         raise ContainerError(f"not an mlbf container ({blob[:8]!r})")
     r = _Reader(blob, 8)
     alg = r.u8()
@@ -139,7 +163,8 @@ def decode_mlbf(blob: bytes) -> FilterArtifact:
             ordinal=ordinal, n=n,
             cascade=FilterCascade(fp_rate=fp_rate, n_included=n,
                                   layers=layers)))
-    return FilterArtifact(fp_rate=fp_rate, groups=groups)
+    return FilterArtifact(fp_rate=fp_rate, groups=groups,
+                          fmt=_FMT_BY_MAGIC[blob[:8]])
 
 
 # -- clubcard -------------------------------------------------------------
@@ -182,7 +207,7 @@ def encode_clubcard(art: FilterArtifact) -> bytes:
             dir_out += struct.pack("<iII", g.exp_hour, g.ordinal, g.n)
             dir_out += l0_meta
             dir_out += struct.pack("<II", a_off, e_off)
-        out = bytearray(CLUBCARD_MAGIC)
+        out = bytearray(_CLUB_MAGIC_BY_FMT[art.fmt])
         out += struct.pack("<Bd", HASH_ALG_KM_SHA256, art.fp_rate)
         out += struct.pack("<III", len(ordered), len(dir_out),
                            len(approx))
@@ -191,7 +216,7 @@ def encode_clubcard(art: FilterArtifact) -> bytes:
 
 
 def decode_clubcard(blob: bytes) -> FilterArtifact:
-    if blob[:8] != CLUBCARD_MAGIC:
+    if blob[:8] not in (CLUBCARD_MAGIC, CLUBCARD_MAGIC2):
         raise ContainerError(f"not a clubcard container ({blob[:8]!r})")
     r = _Reader(blob, 8)
     alg = r.u8()
@@ -241,7 +266,8 @@ def decode_clubcard(blob: bytes) -> FilterArtifact:
     if r.pos != dir_end:
         raise ContainerError(
             f"clubcard directory desync ({r.pos} != {dir_end})")
-    return FilterArtifact(fp_rate=fp_rate, groups=groups)
+    return FilterArtifact(fp_rate=fp_rate, groups=groups,
+                          fmt=_FMT_BY_MAGIC[blob[:8]])
 
 
 # -- dispatch -------------------------------------------------------------
@@ -257,8 +283,8 @@ def encode_container(art: FilterArtifact, kind: str) -> bytes:
 
 
 def decode_container(blob: bytes) -> FilterArtifact:
-    if blob[:8] == MLBF_MAGIC:
+    if blob[:8] in (MLBF_MAGIC, MLBF_MAGIC2):
         return decode_mlbf(blob)
-    if blob[:8] == CLUBCARD_MAGIC:
+    if blob[:8] in (CLUBCARD_MAGIC, CLUBCARD_MAGIC2):
         return decode_clubcard(blob)
     raise ContainerError(f"unknown container magic {blob[:8]!r}")

@@ -2,8 +2,17 @@
 format (ROADMAP item 4 — "a client pulls KBs, not the full cascade").
 
 A delta is computed between two consecutive epochs' deterministic
-``CTMRFL01`` bytes (docs/FILTER_FORMAT.md) and captures exactly what
-changed at the group level:
+artifact bytes (docs/FILTER_FORMAT.md) and captures exactly what
+changed at the group level. Two wire magics, one codec: ``CTMRDL01``
+links take ``CTMRFL01`` artifacts to ``CTMRFL01`` artifacts, and
+``CTMRDL02`` links do the same for ``CTMRFL02`` — the record formats
+are identical; the magic pins which artifact format the replay
+re-serializes under (mixed-format deltas are a loud
+:class:`DeltaError`, never a guess). The practical difference is
+upstream of the codec: per-group-universe ``CTMRFL02`` artifacts
+confine churn to the touched groups, so untouched groups diff equal
+and ship ZERO bytes — no sparse-XOR salvage of globally-reshaped
+layers needed (the CTMRDL01 structural floor BENCHLOG r19 measured).
 
 - **removed** — (issuer, expDate) groups present in the base but not
   the target;
@@ -43,12 +52,25 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ct_mapreduce_tpu.filter.artifact import FilterArtifact, FilterGroup
+from ct_mapreduce_tpu.filter.artifact import (
+    FORMAT_FL01,
+    FORMAT_FL02,
+    FilterArtifact,
+    FilterGroup,
+)
 from ct_mapreduce_tpu.filter.cascade import BloomLayer, FilterCascade
-from ct_mapreduce_tpu.telemetry.metrics import measure
+from ct_mapreduce_tpu.telemetry.metrics import incr_counter, measure
 
 MAGIC = b"CTMRDL01"
+MAGIC_DL02 = b"CTMRDL02"
 VERSION = 1
+
+# Artifact format ↔ delta wire magic. The delta magic is a pure
+# function of the artifact format at both ends (compute_delta refuses
+# mixed ends), so a reader knows the replay's serialization format
+# from the first 8 bytes.
+_DELTA_MAGIC = {FORMAT_FL01: MAGIC, FORMAT_FL02: MAGIC_DL02}
+_MAGIC_DELTA_FMT = {MAGIC: FORMAT_FL01, MAGIC_DL02: FORMAT_FL02}
 
 # Default bound on consecutive delta links before a mandatory
 # full-snapshot anchor (the `maxDeltaChain` directive).
@@ -109,6 +131,11 @@ def compute_delta(base: bytes, target: bytes,
     with measure("distrib", "delta_build_s"):
         base_art = FilterArtifact.from_bytes(base)
         target_art = FilterArtifact.from_bytes(target)
+        if base_art.fmt != target_art.fmt:
+            raise DeltaError(
+                f"delta endpoints in different artifact formats "
+                f"({base_art.fmt} -> {target_art.fmt}): re-anchor with "
+                f"a full snapshot instead of a delta")
         payload = bytearray()
         removed = sorted(set(base_art.groups) - set(target_art.groups))
         added, patched = [], []
@@ -142,7 +169,10 @@ def compute_delta(base: bytes, target: bytes,
             "toEpoch": int(to_epoch),
             "version": VERSION,
         }, sort_keys=True, separators=(",", ":")).encode()
-    return MAGIC + struct.pack("<I", len(header)) + header + bytes(payload)
+        incr_counter("distrib", "delta_groups_shipped",
+                     value=float(len(added) + len(patched)))
+    return (_DELTA_MAGIC[target_art.fmt] + struct.pack("<I", len(header))
+            + header + bytes(payload))
 
 
 def _groups_equal(a: FilterGroup, b: FilterGroup) -> bool:
@@ -157,12 +187,20 @@ def _groups_equal(a: FilterGroup, b: FilterGroup) -> bool:
     return True
 
 
-def parse_delta(blob: bytes) -> tuple[dict, bytes]:
-    """(header, payload) of one delta blob; loud on wrong magic or an
-    unknown version (readers must never guess)."""
-    if blob[:8] != MAGIC:
+def delta_format(blob: bytes) -> str:
+    """The artifact format (``fl01`` | ``fl02``) a delta blob's replay
+    re-serializes under, from its wire magic."""
+    fmt = _MAGIC_DELTA_FMT.get(blob[:8])
+    if fmt is None:
         raise DeltaError(
             f"not a ct-mapreduce filter delta (magic {blob[:8]!r})")
+    return fmt
+
+
+def parse_delta(blob: bytes) -> tuple[dict, bytes]:
+    """(header, payload) of one delta blob (either magic); loud on
+    wrong magic or an unknown version (readers must never guess)."""
+    delta_format(blob)
     (hlen,) = struct.unpack("<I", blob[8:12])
     header = json.loads(blob[12:12 + hlen].decode())
     if header.get("version") != VERSION:
@@ -183,7 +221,7 @@ def split_bundle(blob: bytes) -> list[bytes]:
     out = []
     pos = 0
     while pos < len(blob):
-        if blob[pos:pos + 8] != MAGIC:
+        if blob[pos:pos + 8] not in _MAGIC_DELTA_FMT:
             raise DeltaError(f"bundle desync at byte {pos}")
         (hlen,) = struct.unpack("<I", blob[pos + 8:pos + 12])
         header = json.loads(blob[pos + 12:pos + 12 + hlen].decode())
@@ -211,12 +249,17 @@ def apply_delta(base: bytes, delta: bytes) -> bytes:
     against the header's ``targetSha256`` — the output is either
     byte-identical to the full build or a loud :class:`DeltaError`."""
     header, payload = parse_delta(delta)
+    fmt = delta_format(delta)
     if artifact_sha256(base) != header["baseSha256"]:
         raise DeltaError(
             f"delta base mismatch: have {artifact_sha256(base)[:16]}…, "
             f"delta expects {header['baseSha256'][:16]}… (epoch "
             f"{header['fromEpoch']})")
     art = FilterArtifact.from_bytes(base)
+    if art.fmt != fmt:
+        raise DeltaError(
+            f"delta format mismatch: base artifact is {art.fmt}, link "
+            f"replays {fmt}")
     groups = {(g.issuer, g.exp_id): g
               for _, g in sorted(art.groups.items())}
     for key in header["removed"]:
@@ -265,7 +308,7 @@ def apply_delta(base: bytes, delta: bytes) -> bytes:
                 layers=layers))
     out = FilterArtifact(
         fp_rate=header["fpRate"],
-        groups=[groups[k] for k in sorted(groups)]).to_bytes()
+        groups=[groups[k] for k in sorted(groups)], fmt=fmt).to_bytes()
     got = artifact_sha256(out)
     if got != header["targetSha256"]:
         raise DeltaError(
@@ -325,11 +368,15 @@ class ChainManifest:
     latest_bytes: int = 0
     anchors: list[int] = field(default_factory=list)
     links: list[ChainLink] = field(default_factory=list)
+    # The chain's delta wire format ("CTMRDL01" | "CTMRDL02") — every
+    # link in one manifest shares it (compute_delta refuses mixed
+    # ends, so a format rev always re-anchors).
+    fmt: str = "CTMRDL01"
 
     def to_json(self) -> dict:
         return {
             "anchors": sorted(self.anchors),
-            "format": MAGIC.decode(),
+            "format": self.fmt,
             "latestBytes": self.latest_bytes,
             "latestEpoch": self.latest_epoch,
             "latestSha256": self.latest_sha256,
@@ -345,7 +392,8 @@ class ChainManifest:
                    latest_sha256=d["latestSha256"],
                    latest_bytes=int(d["latestBytes"]),
                    anchors=[int(a) for a in d["anchors"]],
-                   links=[ChainLink.from_json(li) for li in d["links"]])
+                   links=[ChainLink.from_json(li) for li in d["links"]],
+                   fmt=d.get("format", MAGIC.decode()))
 
     def link_path(self, from_epoch: int,
                   to_epoch: int) -> list[ChainLink] | None:

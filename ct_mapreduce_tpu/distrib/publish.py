@@ -169,9 +169,13 @@ class FilterDistributor:
                 containers=cont, container_etags=cont_etags)
             if latest is not None:
                 links_since_anchor = self._links_since_anchor()
-                if links_since_anchor >= self.max_chain:
-                    # Mandatory full-snapshot anchor: no link into this
-                    # epoch; older clients full-pull from here.
+                if (links_since_anchor >= self.max_chain
+                        or self._epochs[latest].blob[:8] != blob[:8]):
+                    # Mandatory full-snapshot anchor: chain budget
+                    # exhausted, or the artifact format changed under
+                    # us (an fl01→fl02 rollover can never delta — the
+                    # codec refuses mixed ends); older clients
+                    # full-pull from here.
                     self._anchors.append(epoch)
                     incr_counter("distrib", "anchor")
                 else:
@@ -243,12 +247,19 @@ class FilterDistributor:
     def _manifest_locked(self) -> deltas.ChainManifest:
         latest = max(self._epochs) if self._epochs else -1
         pe = self._epochs.get(latest)
+        # The chain's delta format follows the published artifact
+        # format (CTMRFL02 epochs link as CTMRDL02); an empty store
+        # reports the legacy default.
+        fmt = deltas.MAGIC.decode()
+        if pe is not None and pe.blob[:8] == b"CTMRFL02":
+            fmt = deltas.MAGIC_DL02.decode()
         return deltas.ChainManifest(
             latest_epoch=latest,
             latest_sha256=pe.sha256 if pe else "",
             latest_bytes=len(pe.blob) if pe else 0,
             anchors=sorted(self._anchors),
-            links=[li for _, (li, _) in sorted(self._links.items())])
+            links=[li for _, (li, _) in sorted(self._links.items())],
+            fmt=fmt)
 
     def manifest(self) -> dict:
         """The chain-manifest JSON body (``GET /filter/manifest``),

@@ -20,14 +20,22 @@ from typing import NamedTuple
 from ct_mapreduce_tpu.config import profile as platprofile
 from ct_mapreduce_tpu.filter.artifact import (  # noqa: F401
     DEFAULT_FP_RATE,
+    FORMAT_FL01,
+    FORMAT_FL02,
     FilterArtifact,
     build_artifact,
     build_artifact_from_sources,
     build_from_aggregator,
     build_from_merged,
     canonical_keys,
+    default_format,
+    normalize_format,
     read_artifact,
     write_artifact,
+)
+from ct_mapreduce_tpu.filter.cache import (  # noqa: F401
+    GroupBuildCache,
+    content_token,
 )
 from ct_mapreduce_tpu.filter.cascade import (  # noqa: F401
     BloomLayer,
@@ -63,6 +71,15 @@ _FILTER_KNOBS = (
     platprofile.Knob("filterFusedLanes", "CTMR_FILTER_FUSED_LANES",
                      0, parse=int, is_set=platprofile.pos_int,
                      post=int),
+    # Round 20 — artifact format: fl02 (per-group universes,
+    # decoupled deltas, incremental rebuilds) is the default;
+    # fl01 is the compatibility path. normalize_format raises on
+    # junk, so a bad env value is ignored by the ladder and a bad
+    # explicit/profile value fails loudly.
+    platprofile.Knob("filterFormat", "CTMR_FILTER_FORMAT",
+                     FORMAT_FL02, parse=normalize_format,
+                     is_set=platprofile.nonempty_str,
+                     post=normalize_format),
 )
 
 
@@ -74,12 +91,13 @@ class FilterKnobs(NamedTuple):
     spill_mb: int
     stream_chunk: int  # 0 = stream.DEFAULT_STREAM_CHUNK
     fused_lanes: int  # 0 = fused.DEFAULT_MAX_LANES
+    fmt: str = FORMAT_FL02  # artifact format ("fl01" | "fl02")
 
 
 def resolve_filter(emit=None, path: str = "", fp_rate: float = 0.0,
                    state_path: str = "", spill_dir: str = "",
                    spill_mb: int = 0, stream_chunk: int = 0,
-                   fused_lanes: int = 0) -> FilterKnobs:
+                   fused_lanes: int = 0, fmt: str = "") -> FilterKnobs:
     """Resolve the filter knobs through the shared platformProfile
     ladder (config/profile.py): explicit value (config directive /
     kwarg) > ``CTMR_EMIT_FILTER`` / ``CTMR_FILTER_PATH`` /
@@ -88,8 +106,9 @@ def resolve_filter(emit=None, path: str = "", fp_rate: float = 0.0,
     ``CTMR_FILTER_FUSED_LANES`` env > profile ``knobs.filter`` >
     defaults (off; ``<aggStatePath>.filter``; 0.01 target FP rate;
     spill off with a 256 MB memory tier; built-in stream/fused
-    shapes). Unparseable env values are ignored, matching the config
-    layer's tolerance."""
+    shapes; ``filterFormat`` / ``CTMR_FILTER_FORMAT`` → fl02).
+    Unparseable env values are ignored, matching the config layer's
+    tolerance."""
     r = platprofile.resolve_section("filter", _FILTER_KNOBS, {
         "emitFilter": emit,
         "filterPath": path or "",
@@ -98,6 +117,7 @@ def resolve_filter(emit=None, path: str = "", fp_rate: float = 0.0,
         "filterCaptureSpillMB": int(spill_mb or 0),
         "filterStreamChunk": int(stream_chunk or 0),
         "filterFusedLanes": int(fused_lanes or 0),
+        "filterFormat": fmt or "",
     })
     p = r["filterPath"]
     if not p and state_path:
@@ -107,15 +127,19 @@ def resolve_filter(emit=None, path: str = "", fp_rate: float = 0.0,
         spill_dir=r["filterCaptureSpillDir"],
         spill_mb=r["filterCaptureSpillMB"],
         stream_chunk=r["filterStreamChunk"],
-        fused_lanes=r["filterFusedLanes"])
+        fused_lanes=r["filterFusedLanes"],
+        fmt=r["filterFormat"])
 
 
 __all__ = [
     "DEFAULT_FP_RATE",
+    "FORMAT_FL01",
+    "FORMAT_FL02",
     "BloomLayer",
     "FilterArtifact",
     "FilterCascade",
     "FilterKnobs",
+    "GroupBuildCache",
     "ListGroupSource",
     "PackedGroupSource",
     "SpillCaptureRing",
@@ -124,6 +148,9 @@ __all__ = [
     "build_from_aggregator",
     "build_from_merged",
     "canonical_keys",
+    "content_token",
+    "default_format",
+    "normalize_format",
     "read_artifact",
     "resolve_filter",
     "write_artifact",

@@ -25,11 +25,23 @@ docs/FILTER_FORMAT.md; the invariants that matter here:
   bytewise, layer sizing is a fixed formula, headers are
   sorted-key/compact JSON, and no wall-clock enters the bytes: the
   same aggregation state always serializes to the same artifact.
-- **Exactness.** Each group's cascade is built with *every other
-  group's keys* as its excluded universe, so any serial known to the
-  aggregation state answers its (issuer, expDate) membership exactly;
-  serials outside the state see ≈ the target FP rate and are killed
-  by the serve plane's table-confirm tier.
+- **Exactness.** In the ``CTMRFL01`` format each group's cascade is
+  built with *every other group's keys* as its excluded universe, so
+  any serial known to the aggregation state answers its (issuer,
+  expDate) membership exactly; serials outside the state see ≈ the
+  target FP rate and are killed by the serve plane's table-confirm
+  tier.
+- **Per-group universes (CTMRFL02, the default).** Each group's
+  cascade builds against its OWN observed universe only: keys hash
+  under ordinal 0 (no cross-group issuer numbering) and the excluded
+  set is empty, so the cascade is a single Bloom layer at the target
+  FP rate. One group's churn can never move another group's bytes —
+  the property the delta plane (CTMRDL02) and the dirty-group
+  incremental build path (filter/cache.py) are built on. The trade:
+  a query against the WRONG group (a serial the state knows only
+  under a different (issuer, expDate)) now false-positives at ≈ the
+  target rate instead of answering exactly; the serve tier's
+  table-confirm kills those exactly as it kills ordinary FPs.
 """
 
 from __future__ import annotations
@@ -55,8 +67,48 @@ from ct_mapreduce_tpu.telemetry import trace
 from ct_mapreduce_tpu.telemetry.metrics import incr_counter, measure, set_gauge
 
 MAGIC = b"CTMRFL01"
+MAGIC_FL02 = b"CTMRFL02"
 VERSION = 1
 DEFAULT_FP_RATE = 0.01
+
+# Format names (the `filterFormat` directive / CTMR_FILTER_FORMAT
+# values). fl02 — per-group universes — is the default; fl01 is the
+# compatibility path for consumers pinned to the global-universe
+# format (round-15/19 golden artifacts).
+FORMAT_FL01 = "fl01"
+FORMAT_FL02 = "fl02"
+_FORMAT_MAGIC = {FORMAT_FL01: MAGIC, FORMAT_FL02: MAGIC_FL02}
+_MAGIC_FORMAT = {MAGIC: FORMAT_FL01, MAGIC_FL02: FORMAT_FL02}
+
+
+def normalize_format(fmt: str) -> str:
+    """One canonical spelling per format; loud on unknown values."""
+    f = str(fmt).strip().lower()
+    if f in ("fl01", "ctmrfl01"):
+        return FORMAT_FL01
+    if f in ("fl02", "ctmrfl02"):
+        return FORMAT_FL02
+    raise ValueError(f"unknown filter format {fmt!r} "
+                     f"(expected fl01 or fl02)")
+
+
+def default_format() -> str:
+    """The build-time artifact format: ``CTMR_FILTER_FORMAT`` env
+    (``fl01`` | ``fl02``) when set and parseable, else fl02."""
+    v = os.environ.get("CTMR_FILTER_FORMAT", "").strip()
+    if v:
+        try:
+            return normalize_format(v)
+        except ValueError:
+            pass  # unparseable env ignored (config-layer tolerance)
+    return FORMAT_FL02
+
+
+def resolve_format(fmt) -> str:
+    """None/empty → the default format; otherwise normalized."""
+    if fmt is None or fmt == "":
+        return default_format()
+    return normalize_format(fmt)
 
 _jit_cache: dict = {}
 
@@ -138,10 +190,17 @@ class FilterGroup:
 
 
 class FilterArtifact:
-    """Parsed (or freshly built) artifact: group directory + cascades."""
+    """Parsed (or freshly built) artifact: group directory + cascades.
 
-    def __init__(self, fp_rate: float, groups: list[FilterGroup]):
+    ``fmt`` is the serialization format (``fl01`` | ``fl02``): it
+    picks the magic ``to_bytes`` writes and round-trips through
+    ``from_bytes``, so re-serializers (delta replay, group slices)
+    preserve the source format."""
+
+    def __init__(self, fp_rate: float, groups: list[FilterGroup],
+                 fmt: str = FORMAT_FL01):
         self.fp_rate = float(fp_rate)
+        self.fmt = normalize_format(fmt)
         self.groups = {(g.issuer, g.exp_id): g for g in groups}
         self._by_hour = {(g.issuer, g.exp_hour): g for g in groups}
 
@@ -212,11 +271,13 @@ class FilterArtifact:
             {"fpRate": self.fp_rate, "groups": entries,
              "nSerials": self.n_serials, "version": VERSION},
             sort_keys=True, separators=(",", ":")).encode()
-        return MAGIC + struct.pack("<I", len(header)) + header + bytes(payload)
+        return (_FORMAT_MAGIC[self.fmt] + struct.pack("<I", len(header))
+                + header + bytes(payload))
 
     @classmethod
     def from_bytes(cls, blob: bytes) -> "FilterArtifact":
-        if blob[:8] != MAGIC:
+        fmt = _MAGIC_FORMAT.get(blob[:8])
+        if fmt is None:
             raise ValueError("not a ct-mapreduce filter artifact "
                              f"(magic {blob[:8]!r})")
         (hlen,) = struct.unpack("<I", blob[8:12])
@@ -241,18 +302,19 @@ class FilterArtifact:
                 cascade=FilterCascade(fp_rate=header["fpRate"],
                                       n_included=int(e["n"]),
                                       layers=layers)))
-        return cls(fp_rate=header["fpRate"], groups=groups)
+        return cls(fp_rate=header["fpRate"], groups=groups, fmt=fmt)
 
     def group_bytes(self, issuer: str, exp) -> bytes | None:
         """A standalone single-group artifact (same format) for the
         serve plane's per-(issuer, expDate) download route. The group
-        keeps its full-artifact ordinal and its cascade was built
-        against the GLOBAL excluded universe, so the slice answers
-        exactly what the full artifact answers."""
+        keeps its full-artifact ordinal; a CTMRFL01 cascade was built
+        against the GLOBAL excluded universe and a CTMRFL02 one
+        against its own, so in both formats the slice answers exactly
+        what the full artifact answers."""
         g = self.group_for(issuer, exp)
         if g is None:
             return None
-        return FilterArtifact(self.fp_rate, [g]).to_bytes()
+        return FilterArtifact(self.fp_rate, [g], fmt=self.fmt).to_bytes()
 
 
 def fused_enabled() -> bool:
@@ -269,17 +331,27 @@ def build_artifact(serial_sets: dict, fp_rate: float = DEFAULT_FP_RATE,
                    use_device: bool | None = None,
                    fused: bool | None = None,
                    stream_chunk: int = 0,
-                   fused_lanes: int = 0) -> FilterArtifact:
+                   fused_lanes: int = 0,
+                   fmt: str | None = None,
+                   cache=None,
+                   tokens: dict | None = None) -> FilterArtifact:
     """Compile ``{(issuerID, expHour): iterable of serial bytes}`` into
     a deterministic artifact: each group's cascade includes its own
-    serials and excludes every other group's keys."""
+    serials and (fl01 only) excludes every other group's keys.
+    ``tokens`` optionally maps the same keys to per-group content
+    tokens for the incremental ``cache`` (filter/cache.py)."""
     from ct_mapreduce_tpu.filter import stream
 
-    sources = [stream.ListGroupSource(iss, eh, serial_sets[(iss, eh)])
-               for iss, eh in sorted(serial_sets)]
+    sources = []
+    for iss, eh in sorted(serial_sets):
+        src = stream.ListGroupSource(iss, eh, serial_sets[(iss, eh)])
+        if tokens is not None:
+            src.content_token = tokens.get((iss, eh))
+        sources.append(src)
     return build_artifact_from_sources(
         sources, fp_rate=fp_rate, use_device=use_device, fused=fused,
-        stream_chunk=stream_chunk, fused_lanes=fused_lanes)
+        stream_chunk=stream_chunk, fused_lanes=fused_lanes, fmt=fmt,
+        cache=cache)
 
 
 def build_artifact_from_sources(
@@ -287,7 +359,9 @@ def build_artifact_from_sources(
         use_device: bool | None = None,
         fused: bool | None = None,
         stream_chunk: int = 0,
-        fused_lanes: int = 0) -> FilterArtifact:
+        fused_lanes: int = 0,
+        fmt: str | None = None,
+        cache=None) -> FilterArtifact:
     """The round-19 build driver over :class:`stream.GroupSource`
     providers (packed chunks — the 10⁸-scale entry point; the dict
     wrapper above feeds it :class:`stream.ListGroupSource`).
@@ -299,10 +373,19 @@ def build_artifact_from_sources(
     ``fused=False`` / ``CTMR_FILTER_FUSED=0`` for the byte-identical
     per-group reference path). Streamed, fused, in-memory, and
     fleet-merged builds of the same logical state produce identical
-    ``CTMRFL01`` bytes (the round-15 contract, property-tested)."""
+    bytes in either format (the round-15 contract, property-tested).
+
+    ``fmt`` picks the artifact format (``fl01`` global universes /
+    ``fl02`` per-group universes; None → :func:`default_format`).
+    ``cache`` (a :class:`filter.cache.GroupBuildCache`, fl02 only)
+    arms the dirty-group incremental path: sources whose
+    ``content_token`` matches the cache reuse the prior build's
+    group VERBATIM — no key generation, no scatter — and only dirty
+    groups rebuild, so the epoch tick costs O(churn)."""
     from ct_mapreduce_tpu.filter import fused as fused_mod
     from ct_mapreduce_tpu.filter import stream
 
+    fmt = resolve_format(fmt)
     if fused is None:
         fused = fused_enabled()
     stream_chunk = int(stream_chunk) or stream.DEFAULT_STREAM_CHUNK
@@ -313,12 +396,32 @@ def build_artifact_from_sources(
                        groups=len(sources)):
         sources = sorted(sources, key=lambda s: (s.issuer, s.exp_hour))
         issuers = sorted({s.issuer for s in sources})
-        ordinal = {iss: i for i, iss in enumerate(issuers)}
-        group_keys = []
-        meta = []
+        if fmt == FORMAT_FL01:
+            ordinal = {iss: i for i, iss in enumerate(issuers)}
+        else:
+            # CTMRFL02: every group hashes under ordinal 0. A new
+            # issuer appearing must not renumber — and thereby re-key —
+            # every other issuer's groups; the issuerID in the
+            # fingerprint's group identity lives in the (issuer,
+            # expHour) directory key, not the hashed message.
+            ordinal = {iss: 0 for iss in issuers}
+        reused: dict = {}
+        build_srcs = []
         for src in sources:
             if src.n == 0:
                 continue
+            hit = None
+            if cache is not None and fmt == FORMAT_FL02:
+                hit = cache.get(src.issuer, src.exp_hour,
+                                getattr(src, "content_token", None),
+                                fp_rate)
+            if hit is not None:
+                reused[(src.issuer, src.exp_hour)] = hit
+            else:
+                build_srcs.append(src)
+        group_keys = []
+        meta = []
+        for src in build_srcs:
             keys = stream.collect_keys(
                 src, ordinal[src.issuer], stream_chunk,
                 use_device=use_device)
@@ -326,7 +429,22 @@ def build_artifact_from_sources(
             meta.append(src)
             peak_rss = max(peak_rss, stream._rss_bytes())
         global LAST_BUILD_STATS
-        if fused:
+        if fmt == FORMAT_FL02:
+            if fused:
+                cascades, stats = fused_mod.build_cascades_grouped(
+                    group_keys, fp_rate, use_device=use_device,
+                    max_lanes=fused_lanes, consume=True)
+                set_gauge("filter", "fused_groups_per_dispatch",
+                          value=stats.mean_groups_per_dispatch())
+                peak_rss = max(peak_rss, stats.peak_rss)
+                LAST_BUILD_STATS = stats
+            else:
+                no_exc = np.zeros((0, 4), np.uint32)
+                cascades = [FilterCascade.build(k, no_exc, fp_rate,
+                                                use_device=use_device)
+                            for k in group_keys]
+                LAST_BUILD_STATS = None
+        elif fused:
             cascades, stats = fused_mod.build_cascades_fused(
                 group_keys, fp_rate, use_device=use_device,
                 max_lanes=fused_lanes, consume=True)
@@ -341,12 +459,25 @@ def build_artifact_from_sources(
         del group_keys
         groups = []
         for src, cascade in zip(meta, cascades):
-            groups.append(FilterGroup(
+            g = FilterGroup(
                 issuer=src.issuer,
                 exp_id=ExpDate.from_unix_hour(src.exp_hour).id(),
                 exp_hour=src.exp_hour, ordinal=ordinal[src.issuer],
-                n=src.n, cascade=cascade))
-        art = FilterArtifact(fp_rate=fp_rate, groups=groups)
+                n=src.n, cascade=cascade)
+            groups.append(g)
+            if cache is not None and fmt == FORMAT_FL02:
+                cache.put(src.issuer, src.exp_hour,
+                          getattr(src, "content_token", None),
+                          fp_rate, g)
+        for key in sorted(reused):
+            groups.append(reused[key])
+        if fmt == FORMAT_FL02:
+            set_gauge("filter", "dirty_groups", value=float(len(meta)))
+            set_gauge("filter", "groups_reused",
+                      value=float(len(reused)))
+            if cache is not None:
+                cache.prune({(g.issuer, g.exp_hour) for g in groups})
+        art = FilterArtifact(fp_rate=fp_rate, groups=groups, fmt=fmt)
         peak_rss = max(peak_rss, stream._rss_bytes())
     build_s = time.perf_counter() - t0
     set_gauge("filter", "serials", value=float(art.n_serials))
@@ -395,9 +526,45 @@ def capture_by_identity(capture: dict, registry) -> dict:
     return out
 
 
+def capture_tokens(capture: dict, hashes: dict | None,
+                   registry) -> dict:
+    """Identity-keyed per-group content tokens ({(issuerID, expHour):
+    (n, xor-hash)}) for the incremental build cache. Exact
+    incrementally-maintained hashes from the capture layer are used
+    when available; otherwise the token recomputes from the serial
+    set (sha256 per serial — far cheaper than the rebuild a missing
+    token would force). A group fed by more than one registry index
+    recomputes from its merged set: XOR-combining per-index hashes
+    would cancel serials present under both indices."""
+    from ct_mapreduce_tpu.filter.cache import content_token
+
+    merged: dict = {}
+    contrib: dict = {}
+    for (idx, eh), serials in sorted(capture.items()):
+        if not serials:
+            continue
+        iss = registry.issuer_at(int(idx)).id()
+        key = (iss, int(eh))
+        merged.setdefault(key, set()).update(serials)
+        contrib.setdefault(key, []).append((int(idx), int(eh)))
+    out = {}
+    for key in sorted(merged):
+        srcs = contrib[key]
+        if hashes is not None and len(srcs) == 1 and srcs[0] in hashes:
+            out[key] = (len(merged[key]), hashes[srcs[0]])
+        else:
+            out[key] = content_token(merged[key])
+    return out
+
+
 def build_from_aggregator(agg, fp_rate: float = DEFAULT_FP_RATE,
-                          use_device: bool | None = None) -> FilterArtifact:
-    """Artifact from a live aggregator's filter capture."""
+                          use_device: bool | None = None,
+                          fmt: str | None = None,
+                          cache=None) -> FilterArtifact:
+    """Artifact from a live aggregator's filter capture. With a
+    ``cache`` (fl02), per-group content tokens come from the capture
+    layer's incrementally-maintained hashes where exact, so clean
+    groups reuse the prior epoch's blocks verbatim."""
     if getattr(agg, "filter_capture", None) is None:
         raise ValueError(
             "aggregator has no filter capture; enable emitFilter (or "
@@ -410,28 +577,41 @@ def build_from_aggregator(agg, fp_rate: float = DEFAULT_FP_RATE,
     with (lock if lock is not None else contextlib.nullcontext()):
         capture = {key: set(serials)
                    for key, serials in sorted(agg.filter_capture.items())}
+        hashes = (agg.capture_content_hashes()
+                  if hasattr(agg, "capture_content_hashes") else None)
+    tokens = (capture_tokens(capture, hashes, agg.registry)
+              if cache is not None else None)
     return build_artifact(
         capture_by_identity(capture, agg.registry),
-        fp_rate=fp_rate, use_device=use_device)
+        fp_rate=fp_rate, use_device=use_device, fmt=fmt, cache=cache,
+        tokens=tokens)
 
 
 def build_from_merged(merged, fp_rate: float = DEFAULT_FP_RATE,
                       allow_partial: bool = False,
-                      use_device: bool | None = None) -> FilterArtifact:
+                      use_device: bool | None = None,
+                      fmt: str | None = None,
+                      cache=None) -> FilterArtifact:
     """Artifact from a fleet's merged checkpoints
     (:class:`ct_mapreduce_tpu.agg.merge.MergedAggregate`). Every folded
     checkpoint must carry a filter capture (a worker that ran with
     emitFilter off contributes device-lane serials only as hashes —
     unrecoverable), unless ``allow_partial`` explicitly accepts an
-    artifact over the capturing subset."""
+    artifact over the capturing subset. Cache tokens always recompute
+    from the merged union sets — per-worker hashes must never
+    XOR-combine (shared serials would cancel)."""
     missing = getattr(merged, "capture_missing", [])
     if missing and not allow_partial:
         raise ValueError(
             "merged checkpoints without a filter capture (run workers "
             f"with emitFilter=true): {missing}")
+    tokens = (capture_tokens(merged.filter_serials, None,
+                             merged.registry)
+              if cache is not None else None)
     return build_artifact(
         capture_by_identity(merged.filter_serials, merged.registry),
-        fp_rate=fp_rate, use_device=use_device)
+        fp_rate=fp_rate, use_device=use_device, fmt=fmt, cache=cache,
+        tokens=tokens)
 
 
 def write_artifact(path: str, blob: bytes) -> None:

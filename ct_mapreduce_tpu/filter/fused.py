@@ -530,6 +530,179 @@ def _escalate_group(st, params_jg, S, U, level, use_device,
                     else np.zeros((0,), np.int32))
 
 
+def build_cascades_grouped(
+        group_keys: list, fp_rate: float,
+        use_device: Optional[bool] = None,
+        max_lanes: int = 0,
+        max_arena_bits: int = 0,
+        consume: bool = False) -> tuple[list, FusedStats]:
+    """The ``CTMRFL02`` fused build: one Bloom layer per group over
+    the group's OWN unique keys (empty excluded universe — per-group
+    universes never consult other groups' keys), batched through the
+    same arena scatter the cascade rounds use. With no excluded set
+    there is no false-positive chase, no deeper layers, and no stall
+    escalation: the whole build is one layer-round of scatters.
+
+    Byte-identical per group to ``FilterCascade.build(keys_g,
+    <empty>, fp_rate)`` — same unique-count sizing, same probe math,
+    same word packing (32-bit-aligned arena offsets slice exactly)."""
+    max_lanes = int(max_lanes) or DEFAULT_MAX_LANES
+    max_arena_bits = int(max_arena_bits) or DEFAULT_MAX_ARENA_BITS
+    G = len(group_keys)
+    stats = FusedStats()
+    if G == 0:
+        return [], stats
+    from ct_mapreduce_tpu.filter.stream import _rss_bytes
+
+    uniq: list = []
+    for g in range(G):
+        rows = np.asarray(group_keys[g], np.uint32).reshape(-1, 4)
+        if consume:
+            group_keys[g] = None
+        hi, lo = _rows_hilo(rows)
+        uniq.append(rows[_unique_idx(hi, lo)])
+        del rows
+    stats.peak_rss = max(stats.peak_rss, _rss_bytes())
+    cascades = [FilterCascade(fp_rate=float(fp_rate),
+                              n_included=int(uniq[g].shape[0]))
+                for g in range(G)]
+    actives = [g for g in range(G) if uniq[g].shape[0] > 0]
+    if not actives:
+        return cascades, stats
+    params = {g: layer_params(int(uniq[g].shape[0]), fp_rate)
+              for g in actives}
+    segments: list[list[int]] = []
+    seg: list[int] = []
+    seg_bits = 0
+    for g in actives:
+        m = params[g][0]
+        if m > _INT32_BITS_CEIL:
+            raise ValueError(
+                f"layer of {m} bits exceeds the int32 scatter "
+                "range; raise the FP rate or shard the corpus")
+        if seg and seg_bits + m > max_arena_bits:
+            segments.append(seg)
+            seg, seg_bits = [], 0
+        seg.append(g)
+        seg_bits += m
+    if seg:
+        segments.append(seg)
+
+    for seg in segments:
+        offs = np.zeros((len(seg),), np.int64)
+        total = 0
+        for j, g in enumerate(seg):
+            offs[j] = total
+            total += params[g][0]
+        ms = np.array([params[g][0] for g in seg], np.int64)
+        ks = np.array([params[g][1] for g in seg], np.int64)
+        kmax = _pow2(int(ks.max()))
+        total_lanes = int(sum(uniq[g].shape[0] for g in seg))
+        dev = use_device
+        if dev is None:
+            dev = device_enabled() and total_lanes >= DEVICE_BUILD_MIN
+        with trace.span("filter.fused_layer", cat="filter", level=0,
+                        groups=len(seg), lanes=total_lanes,
+                        bits=total, device=int(bool(dev))):
+            chunks = _row_chunks(uniq, seg, max_lanes)
+            if dev:
+                arena = _scatter_device_rows(chunks, offs, ms, ks,
+                                             total, kmax, stats)
+            else:
+                arena = np.zeros((total,), bool)
+                for lane_list in chunks:
+                    keys = np.concatenate(
+                        [rows for _, rows in lane_list])
+                    gid = np.concatenate(
+                        [np.full((rows.shape[0],), j, np.int32)
+                         for j, rows in lane_list])
+                    _scatter_np(arena, keys, gid, 0, offs, ms,
+                                ks.astype(np.int64), kmax)
+                    stats.dispatches += 1
+                    stats.groups_per_dispatch.append(len(lane_list))
+            stats.scatter_lanes += total_lanes
+            stats.layers += len(seg)
+            words_all = _pack_words(arena)
+            del arena
+            for j, g in enumerate(seg):
+                w0 = int(offs[j]) // 32
+                words = words_all[w0: w0 + int(ms[j]) // 32].copy()
+                cascades[g].layers.append(
+                    BloomLayer(m=int(ms[j]), k=int(ks[j]),
+                               words=words))
+                uniq[g] = None  # free as soon as the layer is cut
+        stats.peak_rss = max(stats.peak_rss, _rss_bytes())
+    stats.rounds = 1
+    return cascades, stats
+
+
+def _row_chunks(uniq, seg, max_lanes: int) -> list:
+    """Pack the segment's per-group key rows into ≤max_lanes batches:
+    ``[[(local_gid, uint32[n,4] row slice), ...], ...]`` — the
+    grouped-build analogue of :func:`_lane_chunks` (rows direct, no
+    global S table to index into)."""
+    chunks = []
+    cur: list = []
+    cur_n = 0
+    for j, g in enumerate(seg):
+        rows = uniq[g]
+        pos = 0
+        while pos < rows.shape[0]:
+            take = min(int(rows.shape[0]) - pos, max_lanes - cur_n)
+            if take > 0:
+                cur.append((j, rows[pos: pos + take]))
+                cur_n += take
+                pos += take
+            if cur_n >= max_lanes:
+                chunks.append(cur)
+                cur, cur_n = [], 0
+    if cur:
+        chunks.append(cur)
+    return chunks
+
+
+def _scatter_device_rows(chunks, offs, ms, ks, total_bits, kmax,
+                         stats: FusedStats):
+    """Device lane of the grouped (single-layer) build: identical
+    jitted scatter and shape discipline as :func:`_scatter_device`,
+    with lane keys gathered from row slices instead of S-indices."""
+    import jax.numpy as jnp
+
+    fn = _fused_bits_jit()
+    gp = _pow2(len(ms))
+    offs_p = np.zeros((gp,), np.int32)
+    offs_p[:len(ms)] = offs
+    ms_p = np.ones((gp,), np.int32)
+    ms_p[:len(ms)] = ms
+    ks_p = np.zeros((gp,), np.int32)
+    ks_p[:len(ms)] = ks
+    arena_n = _pow2(total_bits, floor=1 << 20)
+    if arena_n > _INT32_BITS_CEIL:
+        arena_n = min(_INT32_BITS_CEIL,
+                      ((total_bits + (1 << 20) - 1) >> 20) << 20)
+    arena = jnp.zeros((arena_n,), jnp.bool_)
+    offs_d, ms_d, ks_d = (jnp.asarray(a) for a in (offs_p, ms_p, ks_p))
+    for lane_list in chunks:
+        n = int(sum(rows.shape[0] for _, rows in lane_list))
+        width = _pow2(n, floor=16)
+        keys = np.zeros((width, 4), np.uint32)
+        gid = np.zeros((width,), np.int32)
+        valid = np.zeros((width,), bool)
+        pos = 0
+        for j, rows in lane_list:
+            keys[pos: pos + rows.shape[0]] = rows
+            gid[pos: pos + rows.shape[0]] = j
+            pos += rows.shape[0]
+        valid[:n] = True
+        arena = fn(arena, jnp.asarray(keys), jnp.asarray(gid),
+                   jnp.asarray(valid), np.uint32(0), offs_d,
+                   ms_d, ks_d, kmax)
+        stats.dispatches += 1
+        stats.device_dispatches += 1
+        stats.groups_per_dispatch.append(len(lane_list))
+    return np.asarray(arena)[:total_bits]
+
+
 def _lane_chunks(states, seg, max_lanes: int) -> list:
     """Pack the segment's cur_in index sets into ≤max_lanes batches:
     ``[[(local_gid, S-index slice), ...], ...]``."""

@@ -26,6 +26,16 @@ Contracts:
   same idempotence the checkpoint tail replay relies on).
 - **Determinism.** ``items()`` sorts keys and returns sets — what
   downstream writers serialize is a function of content only.
+- **Content hashes (CTMRFL02 dirty tracking).** While the ring has
+  never spilled (and found no pre-existing segments at construction),
+  it maintains exact per-group XOR content hashes incrementally — the
+  memory set IS the full logical content, so first-seen dedup is
+  exact. The first flush permanently invalidates them: a serial
+  re-captured after its set spilled looks new to the memory tier and
+  would double-XOR. ``content_hashes()`` returns None once inexact —
+  callers fall back to recomputation or full rebuild (a false MISS is
+  a redundant rebuild; a false HIT would be a correctness bug, so the
+  ring never guesses).
 
 Record framing (one segment = magic + records until EOF): ``<iq I``
 issuer_idx int32, exp_hour int64, serial length uint32, serial bytes.
@@ -65,6 +75,10 @@ class SpillCaptureRing:
         self._mem_used = 0
         self.spilled_bytes = 0
         existing = self._segments()
+        self._hashes: dict[tuple[int, int], int] = {}
+        # Exact only while every captured serial is still in the memory
+        # tier: pre-existing segments mean unknown prior content.
+        self.hashes_exact = not existing
         self._next_seg = (max(
             (int(os.path.basename(p)[4:12]) for p in existing),
             default=-1) + 1)
@@ -78,6 +92,11 @@ class SpillCaptureRing:
             s = self._mem[key] = set()
         if serial not in s:
             s.add(serial)
+            if self.hashes_exact:
+                from ct_mapreduce_tpu.filter.cache import serial_hash
+
+                self._hashes[key] = (
+                    self._hashes.get(key, 0) ^ serial_hash(serial))
             self._mem_used += len(serial) + _SET_OVERHEAD
             if self._mem_used >= self.mem_bytes:
                 self.flush()
@@ -145,6 +164,10 @@ class SpillCaptureRing:
         self._next_seg += 1
         self._mem = {}
         self._mem_used = 0
+        # Memory dedup no longer covers spilled serials — hashes can
+        # never be trusted again for the life of this directory.
+        self._hashes = {}
+        self.hashes_exact = False
         self.spilled_bytes += n_bytes
         incr_counter("filter", "capture_spilled_bytes",
                      value=float(n_bytes))
@@ -178,6 +201,15 @@ class SpillCaptureRing:
                 break
             merged.setdefault((idx, eh), set()).add(blob[pos: pos + ln])
             pos += ln
+
+    def content_hashes(self) -> dict | None:
+        """Exact per-group XOR content hashes ({(issuer_idx, expHour):
+        int}) when the memory tier still holds the full capture; None
+        once any spill (or a restart over prior segments) made them
+        unverifiable."""
+        if not self.hashes_exact:
+            return None
+        return dict(self._hashes)
 
     def stats(self) -> dict:
         return {

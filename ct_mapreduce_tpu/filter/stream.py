@@ -89,11 +89,17 @@ class GroupSource:
     ``chunks(chunk_size)`` yields ``(lens, mat, oversized)`` blocks:
     conforming serials as a packed matrix, oversized ones as raw bytes
     (the host-lane path). ``n`` is the group's UNIQUE serial count —
-    it lands verbatim in the artifact header."""
+    it lands verbatim in the artifact header.
+
+    ``content_token`` (optional, default None) is an opaque value that
+    changes whenever the group's serial set changes — the dirty-group
+    key of the CTMRFL02 incremental build path (filter/cache.py). None
+    means "unknown": the group always rebuilds."""
 
     issuer: str
     exp_hour: int
     n: int
+    content_token = None
 
     def chunks(self, chunk_size: int) -> Iterator[
             tuple[np.ndarray, np.ndarray, list[bytes]]]:
@@ -107,11 +113,12 @@ class ListGroupSource(GroupSource):
     the legacy path for debuggability)."""
 
     def __init__(self, issuer: str, exp_hour: int,
-                 serials: Iterable[bytes]):
+                 serials: Iterable[bytes], content_token=None):
         self.issuer = issuer
         self.exp_hour = int(exp_hour)
         self._serials = sorted(set(serials))
         self.n = len(self._serials)
+        self.content_token = content_token
 
     def chunks(self, chunk_size: int):
         for start in range(0, self.n, chunk_size):
@@ -130,11 +137,13 @@ class PackedGroupSource(GroupSource):
     serials. Used by the scale driver (synthetic corpora generated
     chunk-by-chunk, never resident) and spill-drained captures."""
 
-    def __init__(self, issuer: str, exp_hour: int, n: int, provider):
+    def __init__(self, issuer: str, exp_hour: int, n: int, provider,
+                 content_token=None):
         self.issuer = issuer
         self.exp_hour = int(exp_hour)
         self.n = int(n)
         self._provider = provider
+        self.content_token = content_token
 
     def chunks(self, chunk_size: int):
         return self._provider(chunk_size)

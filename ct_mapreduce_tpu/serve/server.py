@@ -120,10 +120,14 @@ class FilterTier:
         self.created_wall = time.time()
 
     @classmethod
-    def build(cls, agg, fp_rate: float, epoch: int) -> "FilterTier":
+    def build(cls, agg, fp_rate: float, epoch: int,
+              cache=None) -> "FilterTier":
+        """``cache`` (a :class:`filter.cache.GroupBuildCache`) arms the
+        CTMRFL02 dirty-group path: across refresh ticks only churned
+        groups rebuild (the oracle owns one cache for its lifetime)."""
         from ct_mapreduce_tpu.filter import build_from_aggregator
 
-        art = build_from_aggregator(agg, fp_rate=fp_rate)
+        art = build_from_aggregator(agg, fp_rate=fp_rate, cache=cache)
         ids = [agg.registry.issuer_at(i).id()
                for i in range(len(agg.registry))]
         return cls(art, ids, epoch)
@@ -203,6 +207,12 @@ class MembershipOracle:
         self.filter_first = resolve_filter_first(filter_first)
         self.filter_fp_rate = float(filter_fp_rate) or DEFAULT_FP_RATE
         self.filter_tier: Optional[FilterTier] = None
+        # Epoch-persistent build cache (CTMRFL02): refresh ticks reuse
+        # clean groups' cascades verbatim, so the steady-state refresh
+        # costs O(churn). Harmless for fl01 (the builder ignores it).
+        from ct_mapreduce_tpu.filter import GroupBuildCache
+
+        self.filter_build_cache = GroupBuildCache()
         # Distribution store (round 18): published epochs, delta
         # links, containers, pre-compressed variants — what the
         # /filter* CDN routes serve. Armed alongside the filter tier.
@@ -233,7 +243,8 @@ class MembershipOracle:
         capture."""
         tier = FilterTier.build(
             self._agg, float(fp_rate) or self.filter_fp_rate,
-            self.snapshots.floor_epoch())
+            self.snapshots.floor_epoch(),
+            cache=self.filter_build_cache)
         self.filter_tier = tier
         if self.distributor is not None:
             self.distributor.publish(
@@ -349,6 +360,8 @@ class MembershipOracle:
             body["filter_epoch"] = self.filter_tier.epoch
             body["filter_staleness_s"] = round(self.filter_tier.age_s(), 6)
             body["filter_serials"] = self.filter_tier.artifact.n_serials
+            body["filter_format"] = self.filter_tier.artifact.fmt
+            body["filter_groups_reused"] = self.filter_build_cache.hits
         if self.distributor is not None:
             body.update(self.distributor.stats())
         return body

@@ -163,6 +163,13 @@ class LogKeyRegistry:
             ]  # "_"-prefixed keys are runtime caches (_key_coord, epoch)
             return json.dumps(sorted(entries, key=lambda e: e["log_id"]))
 
+    def entries(self) -> list[dict]:
+        """Snapshot of the registered entries (runtime fields
+        included), sorted by log id — what the Q-table prebuild
+        walks."""
+        with self._lock:
+            return [self._keys[k] for k in sorted(self._keys)]
+
     @classmethod
     def from_json_file(cls, path: str) -> "LogKeyRegistry":
         reg = cls()
@@ -226,6 +233,37 @@ class SignatureVerifier:
             "no_key": 0, "verified": 0, "failed": 0, "batches": 0,
             "p384_lanes": 0, "qtable_hits": 0, "qtable_misses": 0,
         }
+        # Q-table prebuild (round 20, ROADMAP 3): warm the host-side
+        # window table for EVERY registered key at startup on a
+        # background thread, so the first dispatch under each key hits
+        # the process-wide cache instead of paying the table build
+        # inline (the first-dispatch latency spike). point_table_cached
+        # is lock-guarded and keyed on coordinates — a dispatch racing
+        # the prebuild at worst builds the same table first and the
+        # prebuild's call becomes a cache hit.
+        self._prebuild_thread = None
+        if self.window > 0 and len(self.keys):
+            self._prebuild_thread = threading.Thread(
+                target=self._prebuild_qtables,
+                name="verify-qtable-prebuild", daemon=True)
+            self._prebuild_thread.start()
+
+    def _prebuild_qtables(self) -> None:
+        from ct_mapreduce_tpu.ops import ecdsa
+
+        for e in self.keys.entries():
+            alg = e.get("alg")
+            if alg not in ecdsa.CURVE_OPS:
+                continue
+            try:
+                _, build_s = ecdsa.point_table_cached(
+                    ecdsa.CURVE_OPS[alg], self.window,
+                    int(e["x"], 16), int(e["y"], 16))
+            except (KeyError, ValueError):
+                continue  # malformed entry: the dispatch path reports
+            if build_s > 0.0:
+                add_sample("verify", "qtable_build_s", value=build_s)
+                incr_counter("verify", "qtable_prebuilt")
 
     # -- classification + staging ---------------------------------------
     def submit_chunk(self, scts: sctlib.SctBatch, issuer_idx: np.ndarray,

@@ -447,8 +447,9 @@ def test_http_etag_304_encoding_delta_and_worker_parity(served_pair):
     with pytest.raises(urllib.error.HTTPError) as err:
         _get(f"{bases[0]}/filter/container/nope")
     assert err.value.code == 404
-    # Manifest reports the serving inventory.
-    assert man["format"] == "CTMRDL01"
+    # Manifest reports the serving inventory (fl02 default build →
+    # the rev-2 delta wire).
+    assert man["format"] == "CTMRDL02"
     assert man["containers"] == ["clubcard", "mlbf"]
     assert "gzip" in man["encodings"]
     # /healthz carries the distribution stats.

@@ -171,10 +171,13 @@ def test_zero_false_negatives_sharded_layout():
     art = build_from_aggregator(agg, fp_rate=0.01)
     for iss, eh, sb in capture_identity_items(agg):
         assert art.query(iss, eh, sb), (iss, eh, sb.hex())
-    # Cross-group exactness: a known serial answers False for a
-    # neighbouring expiry bucket it does not belong to.
+    # Cross-group exactness is an fl01 (global-universe) guarantee:
+    # every other group's keys sit in this group's excluded set, so a
+    # known serial answers False for a neighbouring expiry bucket with
+    # certainty (fl02 answers False only at 1 - fpRate).
+    art01 = build_from_aggregator(agg, fp_rate=0.01, fmt="fl01")
     iss, eh, sb = capture_identity_items(agg)[0]
-    assert not art.query(iss, eh + 24, sb)
+    assert not art01.query(iss, eh + 24, sb)
 
 
 def test_oversized_serial_rides_capture_and_artifact():

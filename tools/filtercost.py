@@ -111,7 +111,13 @@ def packed_sources(n_serials: int, n_groups: int, seed: int = 7,
     ``epoch_extra`` appends that many further serials to each of the
     first ``churn_groups`` groups (the delta leg's epoch-2 corpus:
     epoch 1 plus growth concentrated where churn really lands —
-    untouched groups must cost zero delta bytes)."""
+    untouched groups must cost zero delta bytes).
+
+    Each source carries an analytic ``content_token``: the serial set
+    is a pure function of (seed, serial_bytes, group, count), so that
+    tuple IS the content identity — no O(corpus) hashing just to feed
+    the dirty-group cache (the token is opaque to the build; only
+    equality matters)."""
     from ct_mapreduce_tpu.filter import PackedGroupSource
 
     base_per = max(1, n_serials // n_groups)
@@ -149,15 +155,18 @@ def packed_sources(n_serials: int, n_groups: int, seed: int = 7,
                         :, : serial_bytes - 8]
                 yield lens, mat, []
 
-        sources.append(PackedGroupSource(
+        src = PackedGroupSource(
             f"scale-issuer-{g % max(1, n_groups // 2)}",
-            500_000 + 24 * g, per, provider))
+            500_000 + 24 * g, per, provider)
+        src.content_token = ("packed", seed, serial_bytes, g, per)
+        sources.append(src)
     return sources
 
 
 def run_scale_leg(n: int, n_groups: int, rate: float, seed: int,
                   fused: bool = True, use_device=None,
-                  stream_chunk: int = 0) -> tuple[dict, bytes]:
+                  stream_chunk: int = 0,
+                  fmt: str | None = None) -> tuple[dict, bytes]:
     """One scale leg: packed corpus → artifact; serials/s, sampled
     peak RSS, and the layer/dispatch collapse."""
     import time as _time
@@ -169,13 +178,14 @@ def run_scale_leg(n: int, n_groups: int, rate: float, seed: int,
     t0 = time.perf_counter()
     art = fartifact.build_artifact_from_sources(
         sources, fp_rate=rate, fused=fused, use_device=use_device,
-        stream_chunk=stream_chunk)
+        stream_chunk=stream_chunk, fmt=fmt)
     build_s = time.perf_counter() - t0
     gauges = get_sink().snapshot().get("gauges", {})
     stats = fartifact.LAST_BUILD_STATS
     blob = art.to_bytes()
     point = {
         "metric": "ct_filter_scale",
+        "format": art.fmt,
         "serials": art.n_serials,
         "groups": len(art.groups),
         "fused": bool(fused),
@@ -199,18 +209,21 @@ def run_scale_leg(n: int, n_groups: int, rate: float, seed: int,
 
 
 def run_delta_leg(n: int, n_groups: int, rate: float, seed: int,
-                  base_blob: bytes, churn: int) -> dict:
-    """CTMRDL01 bits-on-wire at scale (ROADMAP 4(b) residue): epoch 2
+                  base_blob: bytes, churn: int,
+                  fmt: str | None = None) -> dict:
+    """Delta bits-on-wire at scale (ROADMAP 4(b) residue): epoch 2
     = epoch 1 + ``churn`` serials in ONE group (churn is localized —
     the other groups must contribute zero delta payload); measure the
-    delta link (raw + gzip) against the full artifact pull."""
+    delta link (raw + gzip) against the full artifact pull. The wire
+    magic (CTMRDL01/CTMRDL02) follows the artifacts' format."""
     import gzip
 
     from ct_mapreduce_tpu.distrib import delta as delta_mod
     from ct_mapreduce_tpu.filter import artifact as fartifact
 
     sources = packed_sources(n, n_groups, seed=seed, epoch_extra=churn)
-    art2 = fartifact.build_artifact_from_sources(sources, fp_rate=rate)
+    art2 = fartifact.build_artifact_from_sources(sources, fp_rate=rate,
+                                                 fmt=fmt)
     blob2 = art2.to_bytes()
     link = delta_mod.compute_delta(base_blob, blob2, 1, 2)
     replay = delta_mod.apply_delta(base_blob, link)
@@ -218,6 +231,7 @@ def run_delta_leg(n: int, n_groups: int, rate: float, seed: int,
     gz = lambda b: len(gzip.compress(b, mtime=0))  # noqa: E731
     return {
         "metric": "ct_filter_scale_delta",
+        "format": art2.fmt,
         "serials": art2.n_serials,
         "churn_serials": churn,
         "churn_groups": 1,
@@ -229,6 +243,60 @@ def run_delta_leg(n: int, n_groups: int, rate: float, seed: int,
         "delta_vs_full_gzip": round(
             gz(link) / max(1, gz(blob2)), 6),
     }
+
+
+def run_incremental_leg(n: int, n_groups: int, rate: float, seed: int,
+                        churn: int, use_device=None) -> tuple[dict, int]:
+    """The CTMRFL02 dirty-group epoch tick: build epoch 1 through a
+    :class:`GroupBuildCache`, then epoch 2 (= epoch 1 + ``churn``
+    serials in ONE group) through the SAME cache — clean groups reuse
+    their serialized blocks verbatim, only the churned group rebuilds.
+    Honesty checks: the incremental artifact must be byte-identical to
+    an epoch-2 build from scratch (which is also the full-rebuild wall
+    the speedup is measured against). Returns (point, mismatch_rc)."""
+    from ct_mapreduce_tpu.filter import GroupBuildCache
+    from ct_mapreduce_tpu.filter import artifact as fartifact
+
+    cache = GroupBuildCache()
+    t0 = time.perf_counter()
+    fartifact.build_artifact_from_sources(
+        packed_sources(n, n_groups, seed=seed), fp_rate=rate,
+        fmt="fl02", cache=cache, use_device=use_device)
+    epoch1_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    art2 = fartifact.build_artifact_from_sources(
+        packed_sources(n, n_groups, seed=seed, epoch_extra=churn),
+        fp_rate=rate, fmt="fl02", cache=cache, use_device=use_device)
+    incremental_s = time.perf_counter() - t0
+    blob2 = art2.to_bytes()
+
+    # Full-rebuild reference: same epoch-2 corpus, no cache. Doubles
+    # as the byte-identity oracle for the incremental path.
+    t0 = time.perf_counter()
+    ref = fartifact.build_artifact_from_sources(
+        packed_sources(n, n_groups, seed=seed, epoch_extra=churn),
+        fp_rate=rate, fmt="fl02", use_device=use_device)
+    full_s = time.perf_counter() - t0
+    identical = blob2 == ref.to_bytes()
+    if not identical:
+        print(f"BYTE MISMATCH incremental vs from-scratch at n={n}",
+              file=sys.stderr)
+    return {
+        "metric": "ct_filter_incremental",
+        "format": art2.fmt,
+        "serials": art2.n_serials,
+        "groups": len(art2.groups),
+        "churn_serials": churn,
+        "churn_groups": 1,
+        "churn_frac": round(churn / max(1, n), 4),
+        "groups_reused": cache.hits,
+        "epoch1_build_s": round(epoch1_s, 2),
+        "incremental_build_s": round(incremental_s, 2),
+        "full_rebuild_s": round(full_s, 2),
+        "speedup": round(full_s / max(incremental_s, 1e-9), 2),
+        "bytes_identical": identical,
+    }, (0 if identical else 1)
 
 
 def main(argv=None) -> int:
@@ -251,10 +319,21 @@ def main(argv=None) -> int:
                     help="force the NumPy build lane "
                          "(CTMR_FILTER_DEVICE=0 equivalent)")
     ap.add_argument("--delta", type=int, default=0, metavar="CHURN",
-                    help="after each scale leg, measure the CTMRDL01 "
-                         "delta for an epoch adding CHURN serials per "
-                         "group")
+                    help="after each scale leg, measure the "
+                         "CTMRDL01/CTMRDL02 delta for an epoch adding "
+                         "CHURN serials to one group")
+    ap.add_argument("--incremental", type=int, default=0,
+                    metavar="CHURN",
+                    help="after each scale leg, measure the fl02 "
+                         "dirty-group epoch tick: rebuild with CHURN "
+                         "serials added to one group through a warm "
+                         "GroupBuildCache vs a full rebuild")
+    ap.add_argument("--format", default="", choices=("", "fl01", "fl02"),
+                    help="artifact format for the scale/delta legs "
+                         "(default: the CTMR_FILTER_FORMAT ladder, "
+                         "fl02)")
     args = ap.parse_args(argv)
+    fmt = args.format or None
 
     if args.scale:
         use_device = False if args.host_lane else None
@@ -263,12 +342,12 @@ def main(argv=None) -> int:
             n = int(float(spec))
             point, blob = run_scale_leg(
                 n, args.groups, args.scale_rate, args.seed,
-                use_device=use_device)
+                use_device=use_device, fmt=fmt)
             print(json.dumps(point), flush=True)
             if args.legacy:
                 lpoint, lblob = run_scale_leg(
                     n, args.groups, args.scale_rate, args.seed,
-                    fused=False, use_device=use_device)
+                    fused=False, use_device=use_device, fmt=fmt)
                 print(json.dumps(lpoint), flush=True)
                 if lblob != blob:
                     print(f"BYTE MISMATCH fused vs legacy at n={n}",
@@ -277,7 +356,13 @@ def main(argv=None) -> int:
             if args.delta:
                 print(json.dumps(run_delta_leg(
                     n, args.groups, args.scale_rate, args.seed, blob,
-                    args.delta)), flush=True)
+                    args.delta, fmt=fmt)), flush=True)
+            if args.incremental:
+                ipoint, irc = run_incremental_leg(
+                    n, args.groups, args.scale_rate, args.seed,
+                    args.incremental, use_device=use_device)
+                print(json.dumps(ipoint), flush=True)
+                rc = rc or irc
         return rc
 
     state = synth_state(args.serials, args.groups, seed=args.seed)

@@ -210,7 +210,9 @@ def write_worker_ini(path: str, fixture: dict, state_path: str,
                      redis_addr: str = "", worker_id: int = 0,
                      num_workers: int = 1, checkpoint_period: str = "",
                      batch_size: int = 64, table_bits: int = 12,
-                     coordinator: str = "", emit_filter: bool = True) -> None:
+                     coordinator: str = "", emit_filter: bool = True,
+                     query_port: int = 0,
+                     run_forever: bool = False) -> None:
     lines = [
         f"logList = {','.join(fixture['logs'])}",
         "backend = tpu",
@@ -237,6 +239,19 @@ def write_worker_ini(path: str, fixture: dict, state_path: str,
         ]
     if checkpoint_period:
         lines.append(f"checkpointPeriod = {checkpoint_period}")
+    if query_port:
+        # The live-storm leg (tools/pullstorm.py --live-fleet) pulls
+        # /filter + /filter/delta from the WORKERS themselves while
+        # they ingest; a deep distribution history keeps lagging
+        # clients on the delta path for the whole leg.
+        # Deep history + chain budget: every epoch the leg captures
+        # stays delta-servable (no mid-leg anchors/evictions), so the
+        # failover-straddling span is always a pure chain replay.
+        lines += [f"queryPort = {query_port}", "distribHistory = 128",
+                  "maxDeltaChain = 128"]
+    if run_forever:
+        lines += ["runForever = true", "pollingDelayMean = 1s",
+                  "pollingDelayStdDev = 0"]
     with open(path, "w") as fh:
         fh.write("\n".join(lines) + "\n")
 
@@ -286,7 +301,8 @@ def child_main(args) -> int:
         worker_id=args.worker_id, num_workers=args.workers,
         checkpoint_period=args.checkpoint_period,
         batch_size=args.batch_size, table_bits=args.table_bits,
-        coordinator=args.coordinator,
+        coordinator=args.coordinator, query_port=args.query_port,
+        run_forever=args.run_forever,
     )
     from ct_mapreduce_tpu.cmd import ct_fetch
     from ct_mapreduce_tpu.ingest.fleet import (
@@ -320,7 +336,9 @@ def spawn_worker(worker_id: int, workers: int, fixture_path: str,
                  table_bits: int = 12, throttle_ms: float = 0.0,
                  coordinator: str = "",
                  compile_cache: bool = True,
-                 compile_cache_readonly: bool = False) -> subprocess.Popen:
+                 compile_cache_readonly: bool = False,
+                 query_port: int = 0,
+                 run_forever: bool = False) -> subprocess.Popen:
     """Spawn one worker process. Pass ``compile_cache=False`` (no
     persistent cache) for every process involved in a kill-and-resume
     sequence. Observed on this jax/XLA CPU build (stress data in
@@ -355,6 +373,10 @@ def spawn_worker(worker_id: int, workers: int, fixture_path: str,
         argv += ["--checkpoint-period", checkpoint_period]
     if coordinator:
         argv += ["--coordinator", coordinator]
+    if query_port:
+        argv += ["--query-port", str(query_port)]
+    if run_forever:
+        argv += ["--run-forever"]
     return subprocess.Popen(argv, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True, env=env)
 
@@ -478,6 +500,8 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--table-bits", type=int, default=12)
     ap.add_argument("--throttle-ms", type=float, default=0.0)
+    ap.add_argument("--query-port", type=int, default=0)
+    ap.add_argument("--run-forever", action="store_true")
     ap.add_argument("--logs", type=int, default=4)
     ap.add_argument("--entries-per-log", type=int, default=256)
     ap.add_argument("--dupes", type=int, default=16)
