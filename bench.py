@@ -2453,6 +2453,133 @@ def run_fleet_smoke() -> dict:
     }
 
 
+def run_tune_smoke() -> dict:
+    """CT_BENCH_SMOKE autotune leg (round 21): a scaled-down REAL
+    sweep through the whole tune pipeline — measurement providers →
+    coordinate-descent search → profile emission → the config layer
+    actually loading it.
+
+      (1) three providers (staging_e2e, serve_openloop, verify_lanes)
+          sweep their smoke grids with real measurements (replays,
+          open-loop serving, ECDSA kernels) under a tight rep budget;
+      (2) the tuned profile is emitted (fingerprint + provenance) and
+          set as the active platformProfile;
+      (3) END-TO-END load gate: resolve_staging / resolve_serve /
+          resolve_verify — the production resolution paths — must
+          return exactly the tuned values (env and explicit layers
+          silenced for the check).
+
+    Honesty (the rounds-11/14 convention): on this 1-core CI box the
+    per-dispatch toll inverts every K/B curve, so the WINNING POINTS
+    carry no performance claim — what this leg gates is the machinery
+    (measure → search → emit → resolve) with real measurements, not
+    the numbers. The real curves come from tools/campaign.py on a
+    device host.
+    """
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # a CPU gate by contract
+
+    from ct_mapreduce_tpu.config import profile as platprofile
+    from ct_mapreduce_tpu.tune import emit as temit
+    from ct_mapreduce_tpu.tune import measure as tmeasure
+    from ct_mapreduce_tpu.tune import search as tsearch
+
+    t_all = time.perf_counter()
+    # (provider, reps split): staging replays are the heavy evals, one
+    # rep each; verify/serve get a 2-rep confirm.
+    plan = (("staging_e2e", (1, 1)), ("serve_openloop", (1, 1)),
+            ("verify_lanes", (1, 2)))
+    results = []
+    stats = {}
+    for name, reps in plan:
+        m = tmeasure.get_measurement(name)
+        sr = tsearch.coordinate_descent(
+            m.grid("smoke"), m.evaluator("smoke"), maximize=m.maximize,
+            seed=0, budget_evals=12, reps=reps, sweeps=1)
+        if not sr.evaluations:
+            raise BenchError(f"tune smoke {name}: no evaluations ran")
+        if sr.best_value != sr.best_value:  # NaN
+            raise BenchError(f"tune smoke {name}: no feasible point "
+                             f"confirmed (best {sr.best})")
+        if not all(c for c in sr.curves.values()):
+            raise BenchError(f"tune smoke {name}: empty provenance "
+                             f"curve: {sr.curves}")
+        log(f"tune smoke {name}: best {sr.best} -> "
+            f"{sr.best_value:,.1f} {m.unit} "
+            f"({len(sr.evaluations)} evals, {sr.wall_s:.1f}s)")
+        results.append((m, sr))
+        stats[name] = {"best": dict(sr.best),
+                       "best_value": sr.best_value,
+                       "evals": len(sr.evaluations),
+                       "wall_s": round(sr.wall_s, 2)}
+
+    profile = temit.build_profile(results, platform="smoke-cpu")
+    for section in ("staging", "serve", "verify"):
+        if not profile["knobs"].get(section):
+            raise BenchError(f"tune smoke: emitted profile has no "
+                             f"knobs.{section}")
+        if not profile["provenance"].get(section):
+            raise BenchError(f"tune smoke: no provenance.{section}")
+    path = temit.write_profile(
+        os.path.join(tempfile.mkdtemp(prefix="ct-tune-smoke-"),
+                     "tuned_profile.json"), profile)
+
+    # End-to-end: the PRODUCTION resolve paths must see the tuned
+    # values through the profile layer alone.
+    knobs = profile["knobs"]
+    silenced = ("CTMR_PLATFORM_PROFILE", "CTMR_CHUNKS_PER_DISPATCH",
+                "CTMR_STAGING_DEPTH", "CTMR_SERVE_REPLICAS",
+                "CTMR_VERIFY_BATCH", "CTMR_VERIFY_PRECOMP_WINDOW")
+    saved = {env: os.environ.pop(env, None) for env in silenced}
+    os.environ["CTMR_PLATFORM_PROFILE"] = path
+    platprofile.invalidate_cache()
+    try:
+        from ct_mapreduce_tpu.ingest.sync import resolve_staging
+        from ct_mapreduce_tpu.serve.server import resolve_serve
+        from ct_mapreduce_tpu.verify.lane import resolve_verify
+
+        k, depth = resolve_staging()
+        want = (knobs["staging"]["chunksPerDispatch"],
+                knobs["staging"]["stagingDepth"])
+        if (k, depth) != want:
+            raise BenchError(f"tune smoke: resolve_staging returned "
+                             f"{(k, depth)}, profile says {want}")
+        replicas, _device, _cache = resolve_serve()
+        if replicas != knobs["serve"]["serveReplicas"]:
+            raise BenchError(
+                f"tune smoke: resolve_serve replicas {replicas}, "
+                f"profile says {knobs['serve']['serveReplicas']}")
+        _flag, _keys, batch, window, _q = resolve_verify()
+        want_v = (knobs["verify"]["verifyBatch"],
+                  knobs["verify"]["verifyPrecompWindow"])
+        if (batch, window) != want_v:
+            raise BenchError(f"tune smoke: resolve_verify returned "
+                             f"{(batch, window)}, profile says {want_v}")
+    finally:
+        os.environ.pop("CTMR_PLATFORM_PROFILE", None)
+        for env, v in saved.items():
+            if v is not None:
+                os.environ[env] = v
+        platprofile.invalidate_cache()
+    log(f"tune smoke: profile {path} loaded end-to-end "
+        f"(staging {knobs['staging']}, serve {knobs['serve']}, "
+        f"verify {knobs['verify']})")
+
+    return {
+        "metric": "ct_tune_smoke",
+        "value": stats["staging_e2e"]["best_value"],
+        "unit": "entries/s",
+        "smoke_tune_profile_path": path,
+        "smoke_tune_knobs": knobs,
+        "smoke_tune_sweeps": stats,
+        "smoke_tune_loaded": 1,
+        "smoke_tune_wall_s": round(time.perf_counter() - t_all, 2),
+    }
+
+
 def smoke_main() -> int:
     try:
         payload = run_smoke()

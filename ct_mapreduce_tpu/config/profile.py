@@ -34,6 +34,21 @@ section are the directive spellings (``chunksPerDispatch``, not
 compatibility); an unreadable profile warns once and resolves as if
 absent (the config layer's unparseable-value tolerance).
 
+Round 21 (the autotuner, ``ct_mapreduce_tpu/tune/``) grows two
+optional top-level blocks:
+
+- ``"fingerprint"``: the platform identity the profile was measured
+  on (:func:`current_fingerprint` — jax backend, device kind, device
+  count, host cores). When present, it is compared against this
+  host's fingerprint on the keys BOTH sides carry; a mismatch warns
+  once and the profile resolves as if absent — a v5e-tuned profile
+  must never silently steer a CPU box (or vice versa). Profiles
+  without the block (round-18 hand-written ones) load as before.
+- ``"provenance"``: per-knob measurement evidence (curves, reps,
+  wall seconds) written by ``tune/emit.py``. The loader tolerates and
+  ignores it — provenance is for humans and for ``ctmr-tune show``,
+  never for resolution.
+
 The config-parity lint rule covers this layer: every ``CTMR_*`` env
 named in a :class:`Knob` spec must be documented in MIGRATING.md, and
 every section name resolved here must appear in MIGRATING.md's
@@ -70,10 +85,48 @@ def active_profile_path() -> str:
     return _active_path or os.environ.get("CTMR_PLATFORM_PROFILE", "")
 
 
+def current_fingerprint() -> dict:
+    """This host's platform identity, the key a tuned profile is
+    matched against: jax backend + first-device kind + device count +
+    host cores. jax imports lazily (and only when a profile actually
+    carries a fingerprint block) so profile resolution never forces
+    device acquisition; with jax unavailable the fingerprint degrades
+    to the host-only keys and matching proceeds on those."""
+    fp: dict = {"host_cores": os.cpu_count() or 1}
+    try:
+        import jax
+
+        devs = jax.devices()
+        fp["jax_backend"] = jax.default_backend()
+        fp["device_kind"] = devs[0].device_kind if devs else ""
+        fp["device_count"] = len(devs)
+    except Exception:  # pragma: no cover - jax import/init failure
+        pass
+    return fp
+
+
+def fingerprint_matches(profile_fp: dict,
+                        current_fp: Optional[dict] = None) -> bool:
+    """True when the profile's fingerprint agrees with this host on
+    every key BOTH sides carry (a partial fingerprint — e.g. a profile
+    that only pins ``device_kind`` — matches any host with that
+    device). An empty/absent fingerprint matches everything: round-18
+    profiles predate the block."""
+    if not isinstance(profile_fp, dict) or not profile_fp:
+        return True
+    cur = current_fingerprint() if current_fp is None else current_fp
+    return all(profile_fp[k] == cur[k] for k in profile_fp
+               if k in cur)
+
+
 def load_profile(path: str) -> Optional[dict]:
     """Parse + validate one profile file; None (with a one-time
     warning) when unreadable — a bad profile must never kill a run,
-    matching the config layer's tolerance for unparseable values."""
+    matching the config layer's tolerance for unparseable values.
+    A profile carrying a ``fingerprint`` block that does not match
+    this host is rejected the same way (warn once, resolve as if
+    absent); a ``provenance`` block is validated for shape and then
+    ignored by resolution."""
     cached = _cache.get(path, False)
     if cached is not False:
         return cached
@@ -88,12 +141,32 @@ def load_profile(path: str) -> Optional[dict]:
         if data.get("version", PROFILE_VERSION) != PROFILE_VERSION:
             raise ValueError(f"unsupported profile version "
                              f"{data.get('version')!r}")
+        fp = data.get("fingerprint")
+        if fp is not None and not isinstance(fp, dict):
+            raise ValueError("'fingerprint' must be a JSON object")
+        if fp and not fingerprint_matches(fp):
+            raise ValueError(
+                f"platform fingerprint mismatch: profile measured on "
+                f"{fp!r}, this host is {current_fingerprint()!r}")
+        prov = data.get("provenance")
+        if prov is not None and not isinstance(prov, dict):
+            raise ValueError("'provenance' must be a JSON object")
         prof = data
     except (OSError, ValueError) as err:
         print(f"platformProfile ignored ({path}): {err}",
               file=sys.stderr)
     _cache[path] = prof
     return prof
+
+
+def invalidate_cache(path: Optional[str] = None) -> None:
+    """Drop the load cache for one path (or all): the autotuner emits
+    a profile and immediately resolves through it, and tests rewrite
+    profile bytes at a reused path."""
+    if path is None:
+        _cache.clear()
+    else:
+        _cache.pop(path, None)
 
 
 def profile_value(section: str, name: str) -> Any:
@@ -139,6 +212,34 @@ class Knob:
     post: Optional[Callable[[Any], Any]] = None
 
 
+# Layer names, in precedence order — the vocabulary `ctmr-tune show`
+# and explain_section() speak.
+LAYERS = ("explicit", "env", "profile", "default")
+
+
+def _resolve_knob(section: str, knob: Knob,
+                  explicit: dict) -> tuple[Any, str]:
+    """One knob through the four-layer ladder: (pre-post value,
+    winning layer name)."""
+    ev = explicit.get(knob.name)
+    if ev is not None and knob.is_set(ev):
+        return ev, "explicit"
+    if knob.env:
+        raw = os.environ.get(knob.env, "")
+        if raw:
+            try:
+                parsed = knob.parse(raw)
+            except (TypeError, ValueError):
+                parsed = None
+            test = knob.env_is_set or knob.is_set
+            if parsed is not None and test(parsed):
+                return parsed, "env"
+    pv = profile_value(section, knob.name)
+    if pv is not None and knob.is_set(pv):
+        return pv, "profile"
+    return knob.default, "default"
+
+
 def resolve_section(section: str, knobs: tuple,
                     explicit: dict) -> dict:
     """Run the four-layer ladder for every knob of one section.
@@ -146,30 +247,26 @@ def resolve_section(section: str, knobs: tuple,
     strings)."""
     out = {}
     for knob in knobs:
-        value: Any = None
-        chosen = False
-        ev = explicit.get(knob.name)
-        if ev is not None and knob.is_set(ev):
-            value, chosen = ev, True
-        if not chosen and knob.env:
-            raw = os.environ.get(knob.env, "")
-            if raw:
-                try:
-                    parsed = knob.parse(raw)
-                except (TypeError, ValueError):
-                    parsed = None
-                test = knob.env_is_set or knob.is_set
-                if parsed is not None and test(parsed):
-                    value, chosen = parsed, True
-        if not chosen:
-            pv = profile_value(section, knob.name)
-            if pv is not None and knob.is_set(pv):
-                value, chosen = pv, True
-        if not chosen:
-            value = knob.default
+        value, _ = _resolve_knob(section, knob, explicit)
         if knob.post is not None:
             value = knob.post(value)
         out[knob.name] = value
+    return out
+
+
+def explain_section(section: str, knobs: tuple,
+                    explicit: Optional[dict] = None) -> dict:
+    """The debuggability half of the ladder (`ctmr-tune show`): the
+    SAME resolution as :func:`resolve_section`, but each knob maps to
+    ``{"value": <post-processed>, "layer": <winning layer>}`` so an
+    operator can see which of explicit/env/profile/default actually
+    decided every knob."""
+    out = {}
+    for knob in knobs:
+        value, layer = _resolve_knob(section, knob, explicit or {})
+        if knob.post is not None:
+            value = knob.post(value)
+        out[knob.name] = {"value": value, "layer": layer}
     return out
 
 

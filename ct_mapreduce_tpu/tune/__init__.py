@@ -1,0 +1,37 @@
+"""ct_mapreduce_tpu.tune: the knob autotuner (ROADMAP item 1, round 21).
+
+Four layers, each usable alone:
+
+- :mod:`tune.harness` — the shared measurement discipline (warmup
+  excluded but recorded, best-of-N reps, synchronous readbacks,
+  parity asserted at every swept point) that previously lived
+  duplicated inside ``tools/stagecost.py`` and ``tools/qps_sweep.py``.
+- :mod:`tune.measure` — the measurement registry: every bench surface
+  (staged-queue e2e, serve open-loop, verify lanes/s, fleet
+  entries/s, filter build rate) wrapped as a uniform
+  :class:`~ct_mapreduce_tpu.tune.measure.Measurement` provider with
+  structured :class:`~ct_mapreduce_tpu.tune.measure.MeasureResult`\\ s.
+- :mod:`tune.search` — coordinate descent + successive halving over a
+  declared knob grid: wall/eval budgeted, deterministic given a seed.
+- :mod:`tune.emit` — versioned tuned-profile JSON keyed by the
+  platform fingerprint (config/profile.py loads it back through the
+  knob ladder) with per-knob measurement provenance.
+
+:mod:`tune.registry` declares which knobs are sweepable (with their
+ladders) and which are excluded with a justification — the
+config-parity lint rule enforces that every ``Knob`` spec in the tree
+appears in exactly one of the two.
+"""
+
+from ct_mapreduce_tpu.tune.measure import (  # noqa: F401
+    Measurement,
+    MeasureResult,
+    get_measurement,
+    measurements,
+    register,
+)
+from ct_mapreduce_tpu.tune.search import (  # noqa: F401
+    EvalResult,
+    SearchResult,
+    coordinate_descent,
+)

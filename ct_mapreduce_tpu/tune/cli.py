@@ -1,0 +1,136 @@
+"""ctmr-tune: the knob ladder made visible, and the sweep driver.
+
+``ctmr-tune show`` closes the debuggability gap the round-18 loader
+left open: for every profile section it prints each knob's RESOLVED
+value and which layer won (explicit / env / profile / default), plus
+the active profile's path and fingerprint — so "why is K still 1 on
+this host" is one command, not a source dive.
+
+``ctmr-tune sweep`` runs the search driver over one or more
+measurement providers and emits the tuned profile
+(tools/campaign.py wraps this into the resumable device campaign).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _show(args) -> int:
+    import importlib
+
+    from ct_mapreduce_tpu.config import profile as platprofile
+    from ct_mapreduce_tpu.tune import registry
+
+    if args.profile:
+        platprofile.set_active_profile(args.profile)
+    path = platprofile.active_profile_path()
+    prof = platprofile.load_profile(path) if path else None
+    print(f"platformProfile: {path or '(none)'}"
+          + ("" if not path else
+             " [loaded]" if prof else " [IGNORED — see stderr]"))
+    if prof is not None:
+        fp = prof.get("fingerprint") or {}
+        if fp:
+            cur = platprofile.current_fingerprint()
+            ok = platprofile.fingerprint_matches(fp, cur)
+            print(f"  fingerprint: {json.dumps(fp, sort_keys=True)} "
+                  f"({'matches this host' if ok else 'MISMATCH'})")
+        else:
+            print("  fingerprint: (none — profile predates round 21)")
+        if prof.get("platform"):
+            print(f"  platform: {prof['platform']}")
+    explicit = {}
+    if args.config:
+        from ct_mapreduce_tpu.config.config import CTConfig
+
+        cfg = CTConfig.load(["-config", args.config])
+        # Directive spelling -> the loaded field value: the explicit
+        # layer speaks knob names (chunksPerDispatch), not field names.
+        for directive, (fld, _typ) in CTConfig._DIRECTIVES.items():
+            v = getattr(cfg, fld, None)
+            if v is not None:
+                explicit[directive] = v
+    for section, (mod_name, attr) in registry.SECTIONS.items():
+        try:
+            knobs = getattr(importlib.import_module(mod_name), attr)
+        except Exception as err:
+            print(f"[{section}] unavailable: {err}", file=sys.stderr)
+            continue
+        print(f"[{section}]")
+        rows = platprofile.explain_section(
+            section, knobs,
+            {k.name: explicit.get(k.name) for k in knobs})
+        for name, row in rows.items():
+            swept = name in registry.SWEEPABLE.get(section, {})
+            tag = "sweepable" if swept else "excluded"
+            print(f"  {name} = {row['value']!r}  "
+                  f"({row['layer']}; {tag})")
+    return 0
+
+
+def _sweep(args) -> int:
+    from ct_mapreduce_tpu.tune import emit, measure, search
+
+    names = [n for n in args.measure.split(",") if n]
+    results = []
+    for name in names:
+        m = measure.get_measurement(name)
+        grid = m.grid(args.scale)
+        print(f"# sweep {name} ({m.section}): grid "
+              f"{json.dumps(grid)}", file=sys.stderr)
+        sr = search.coordinate_descent(
+            grid, m.evaluator(args.scale), maximize=m.maximize,
+            seed=args.seed, budget_evals=args.budget_evals,
+            budget_wall_s=args.budget_wall_s,
+            reps=(args.reps_lo, args.reps_hi))
+        print(f"# best {name}: {json.dumps(sr.best)} -> "
+              f"{sr.best_value:.1f} {m.unit} "
+              f"({len(sr.evaluations)} evals, {sr.wall_s:.1f}s"
+              f"{', budget exhausted' if sr.budget_exhausted else ''})",
+              file=sys.stderr)
+        results.append((m, sr))
+    profile = emit.build_profile(results, platform=args.platform)
+    if args.out:
+        emit.write_profile(args.out, profile)
+        print(f"# wrote {args.out}", file=sys.stderr)
+    json.dump(profile, sys.stdout, sort_keys=True, indent=1)
+    print()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ctmr-tune")
+    sub = ap.add_subparsers(dest="cmd")
+    shw = sub.add_parser("show", help="dump the resolved knob ladder")
+    shw.add_argument("--profile", default="",
+                     help="profile path (else platformProfile / "
+                     "CTMR_PLATFORM_PROFILE)")
+    shw.add_argument("--config", default="",
+                     help="ct-fetch ini supplying the explicit layer")
+    sw = sub.add_parser("sweep", help="search the knob grid and emit "
+                        "a tuned profile")
+    sw.add_argument("--measure", required=True,
+                    help="comma-separated measurement names "
+                    "(see tune/measure.py)")
+    sw.add_argument("--scale", default="smoke",
+                    choices=("smoke", "full"))
+    sw.add_argument("--out", default="", help="profile output path")
+    sw.add_argument("--platform", default="", help="profile label")
+    sw.add_argument("--seed", type=int, default=0)
+    sw.add_argument("--budget-evals", type=int, default=0)
+    sw.add_argument("--budget-wall-s", type=float, default=0.0)
+    sw.add_argument("--reps-lo", type=int, default=1)
+    sw.add_argument("--reps-hi", type=int, default=3)
+    args = ap.parse_args(argv)
+    if args.cmd == "sweep":
+        return _sweep(args)
+    if args.cmd != "show":
+        args = shw.parse_args([])  # default to `show` with defaults
+    return _show(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

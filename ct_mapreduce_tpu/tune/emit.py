@@ -1,0 +1,100 @@
+"""Tuned-profile emission: search results → the config layer's food.
+
+The output is exactly the file ``config/profile.py`` loads (version 1,
+``knobs.<section>.<knob>``), plus the two round-21 blocks:
+
+- ``fingerprint``: the platform this profile was measured on
+  (:func:`~ct_mapreduce_tpu.config.profile.current_fingerprint`), so
+  the loader refuses to apply it elsewhere;
+- ``provenance``: per-section, per-measurement evidence — the swept
+  point that won, the measured 1-D curves through it, rep counts and
+  harness wall — for humans and ``ctmr-tune show``, ignored by
+  resolution.
+
+Determinism: bytes are a function of the measurements alone — sorted
+keys, fixed separators, no timestamps, no hostnames, no RNG (the
+"no Date.now analogs in emitted bytes" rule; measured walls are data,
+a *current time* would be a build stamp). Writes are atomic
+(tmp + rename) so a preempted campaign never leaves a half profile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ct_mapreduce_tpu.config import profile as platprofile
+from ct_mapreduce_tpu.tune.registry import SWEEPABLE
+
+
+def tuned_knobs(section: str, best_point: dict) -> dict:
+    """The emit-able slice of a search's best point: only knobs the
+    registry declares sweepable for the section carry into the
+    profile (extra swept axes — maxBatch, offered rate — are
+    measurement parameters, not profile knobs)."""
+    allowed = SWEEPABLE.get(section, {})
+    return {k: v for k, v in best_point.items() if k in allowed}
+
+
+def build_profile(results: list, platform: str = "",
+                  fingerprint: Optional[dict] = None) -> dict:
+    """Assemble the profile dict from ``(measurement, SearchResult)``
+    pairs (measurement supplies section/metric/unit identity)."""
+    fp = (dict(fingerprint) if fingerprint is not None
+          else platprofile.current_fingerprint())
+    if not platform:
+        platform = "-".join(
+            str(fp[k]) for k in ("jax_backend", "device_kind",
+                                 "device_count") if k in fp) or "host"
+    knobs: dict = {}
+    provenance: dict = {}
+    for m, sr in results:
+        # NaN best_value = the search never confirmed a feasible
+        # point: nothing to tune from, and NaN must never reach the
+        # emitted bytes (it is not strict JSON).
+        confirmed = sr.best_value == sr.best_value
+        tuned = tuned_knobs(m.section, sr.best) if confirmed else {}
+        if tuned:
+            knobs.setdefault(m.section, {}).update(tuned)
+        provenance.setdefault(m.section, {})[m.name] = {
+            "metric": m.metric,
+            "unit": m.unit,
+            "best_point": dict(sr.best),
+            "best_value": (round(float(sr.best_value), 3)
+                           if confirmed else None),
+            "curves": {k: [[v, round(float(y), 3)] for v, y in c]
+                       for k, c in sr.curves.items()},
+            "evals": len(sr.evaluations),
+            "reps": sum(n for _, n, _ in sr.evaluations),
+            "wall_s": round(float(sr.wall_s), 3),
+            "budget_exhausted": bool(sr.budget_exhausted),
+        }
+    return {
+        "version": platprofile.PROFILE_VERSION,
+        "platform": platform,
+        "fingerprint": fp,
+        "knobs": knobs,
+        "provenance": provenance,
+    }
+
+
+def profile_bytes(profile: dict) -> bytes:
+    return (json.dumps(profile, sort_keys=True, indent=1,
+                       separators=(",", ": ")) + "\n").encode()
+
+
+def write_profile(path: str, profile: dict) -> str:
+    """Atomic write (tmp + rename + fsync) and cache invalidation so
+    a resolve through the same path immediately sees the new bytes."""
+    blob = profile_bytes(profile)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(blob)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    platprofile.invalidate_cache(path)
+    return path

@@ -1,0 +1,483 @@
+"""Shared measurement harness: the bench-methodology discipline, once.
+
+``tools/stagecost.py`` and ``tools/qps_sweep.py`` each grew their own
+copy of the same three habits — a warmup run whose wall is excluded
+from the metric but recorded (compile time is real, it just is not
+throughput), best-of-N timed reps ending in a synchronous value read,
+and a parity assertion against a reference at EVERY swept point (a
+number from a diverging configuration is not a measurement). This
+module is that harness extracted once; the tools now import it, and
+the :mod:`tune.measure` providers build on it.
+
+Everything heavyweight (jax, the aggregator, the serve plane) imports
+lazily inside the functions that need it: the search driver and the
+campaign's resume machinery must be importable — and testable — with
+no device stack at all.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+def say(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+class ParityError(AssertionError):
+    """A swept point diverged from its reference — the measurement at
+    that point is void, and the sweep must not continue past it."""
+
+
+def require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ParityError(msg)
+
+
+@dataclass
+class TimedReps:
+    """Structured timing of one measured call: per-rep walls (the
+    metric derives from ``best``), plus the warmup wall recorded apart
+    — compile/table-build time is excluded from the rate but never
+    hidden."""
+
+    values: list = field(default_factory=list)  # per-rep seconds
+    compile_s: float = 0.0  # warmup wall (compile + first run)
+    wall_s: float = 0.0  # total harness wall incl. warmup
+
+    @property
+    def best(self) -> float:
+        return min(self.values) if self.values else 0.0
+
+    @property
+    def mean(self) -> float:
+        return (sum(self.values) / len(self.values)
+                if self.values else 0.0)
+
+    @property
+    def std(self) -> float:
+        if len(self.values) < 2:
+            return 0.0
+        m = self.mean
+        return (sum((v - m) ** 2 for v in self.values)
+                / (len(self.values) - 1)) ** 0.5
+
+
+def timed_reps(fn: Callable[[], object], reps: int = 3,
+               warmup: bool = True,
+               check: Optional[Callable[[object], None]] = None
+               ) -> TimedReps:
+    """Run ``fn`` (which must end in a synchronous readback — honest
+    timing: dispatch → compute → readback, nothing in flight) once as
+    excluded-but-recorded warmup, then ``reps`` timed times.
+    ``check`` (e.g. a parity assertion) runs on every return value,
+    warmup included."""
+    out = TimedReps()
+    t_all = time.perf_counter()
+    if warmup:
+        t0 = time.perf_counter()
+        r = fn()
+        out.compile_s = time.perf_counter() - t0
+        if check is not None:
+            check(r)
+    for _ in range(max(1, int(reps))):
+        t0 = time.perf_counter()
+        r = fn()
+        out.values.append(time.perf_counter() - t0)
+        if check is not None:
+            check(r)
+    out.wall_s = time.perf_counter() - t_all
+    return out
+
+
+# -- serve-plane harness (moved from tools/qps_sweep.py) ------------------
+
+
+def build_aggregator(entries: int, table_bits: int):
+    """A dedup table pre-filled with ``entries`` synthetic serials
+    (8 zero bytes + 8-byte BE counter — :func:`serial_bytes` probes
+    the same space), via the bulk reinsert path so setup stays off
+    the measured window."""
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.core import packing
+
+    import numpy as np
+
+    agg = TpuAggregator(capacity=1 << table_bits, batch_size=4096,
+                        grow_at=0.0)
+    eh = agg.base_hour + 1000
+    serials = np.zeros((entries, packing.MAX_SERIAL_BYTES), np.uint8)
+    counters = np.arange(entries, dtype=np.uint64)
+    for i in range(8):
+        serials[:, 15 - i] = ((counters >> np.uint64(8 * i))
+                              & np.uint64(0xFF)).astype(np.uint8)
+    slen = np.full((entries,), 16, np.int64)
+    keys = packing.fingerprints_np(
+        np.zeros((entries,), np.int64), np.full((entries,), eh, np.int64),
+        serials, slen)
+    meta = np.full((entries,), packing.pack_meta(0, eh, agg.base_hour),
+                   np.uint32)
+    ovf = agg._bulk_reinsert(keys, meta)
+    if ovf:
+        raise SystemExit(f"table too small: {ovf} overflow rows; "
+                         "raise --table-bits")
+    agg._table_fill = entries
+    agg._device_written = True
+    return agg, eh
+
+
+def serial_bytes(j: int) -> bytes:
+    return b"\x00" * 8 + int(j).to_bytes(8, "big")
+
+
+def make_oracle(agg, eh: int, entries: int, max_batch: int,
+                max_delay_s: float, device: bool, replicas: int,
+                cache_size: int, max_queue_lanes: int = 0):
+    """A warmed MembershipOracle: snapshots pinned and the `contains`
+    kernel compiled at every pow2 width the batcher can form BEFORE
+    the timed window (compiles are per-shape and must not bill it).
+    Probe keys sit outside [0, 2*entries) so warmup never aliases the
+    sweep's probe domain through the cache."""
+    from ct_mapreduce_tpu.serve.server import MembershipOracle
+
+    oracle = MembershipOracle(
+        agg, max_batch=max_batch, max_delay_s=max_delay_s,
+        max_queue_lanes=max_queue_lanes or max(4 * max_batch, 1024),
+        max_staleness_s=60.0, device=device, replicas=replicas,
+        cache_size=cache_size if cache_size != 0 else -1)
+    oracle.snapshots.warm()
+    w = 16
+    while w <= max_batch:
+        oracle.query_raw([(0, eh, serial_bytes(2 * entries + k))
+                          for k in range(w)])
+        w *= 2
+    return oracle
+
+
+def probe_indices(rng, n: int, entries: int, zipf: float):
+    """Probe mix over [0, 2*entries): uniform (zipf=0 — half present,
+    half absent) or zipf-skewed ranks (a hot working set, the traffic
+    shape the hot-serial cache exists for)."""
+    import numpy as np
+
+    if zipf <= 0:
+        return rng.integers(0, 2 * entries, size=n)
+    return np.minimum(rng.zipf(zipf, size=n) - 1, 2 * entries - 1)
+
+
+def run_point(agg, eh: int, entries: int, max_batch: int,
+              max_delay_s: float, threads: int, duration_s: float,
+              device: bool, replicas: int = 1,
+              cache_size: int = -1) -> dict:
+    """Closed-loop sweep point: N client threads back-to-back (the
+    round-10 shape; the arrival process throttles with the clients,
+    so it can never show overload — see :func:`run_open_loop`)."""
+    import threading
+
+    import numpy as np
+
+    from ct_mapreduce_tpu.serve.batcher import Overloaded
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
+    sink = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink)
+    oracle = make_oracle(agg, eh, entries, max_batch, max_delay_s,
+                         device, replicas, cache_size)
+    lat: list[float] = []
+    shed = [0]
+    stop = time.perf_counter() + duration_s
+
+    def client(seed: int) -> None:
+        rng = np.random.default_rng(seed)
+        while time.perf_counter() < stop:
+            j = int(rng.integers(2 * entries))  # half present, half not
+            t0 = time.perf_counter()
+            try:
+                res = oracle.query_raw([(0, eh, serial_bytes(j))])
+            except Overloaded:
+                shed.append(1)
+                continue
+            lat.append(time.perf_counter() - t0)
+            require(res[0][0] == (j < entries), f"parity broke at {j}")
+
+    ts = [threading.Thread(target=client, args=(s,))
+          for s in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    oracle.close()
+    tmetrics.set_sink(prev)
+    snap = sink.snapshot()
+    lanes = snap["counters"].get("serve.lanes", 0.0)
+    batches = snap["counters"].get("serve.batches", 0.0)
+    lat.sort()
+    n = len(lat)
+    return {
+        "max_batch": max_batch,
+        "max_delay_ms": round(max_delay_s * 1e3, 3),
+        "qps": round(n / wall, 1),
+        "p50_ms": round(lat[n // 2] * 1e3, 3) if n else None,
+        "p99_ms": (round(lat[min(n - 1, int(0.99 * n))] * 1e3, 3)
+                   if n else None),
+        "mean_batch_lanes": round(lanes / batches, 2) if batches else 0.0,
+        "shed": len(shed) - 1,
+        "queries": n,
+    }
+
+
+def run_open_loop(agg, eh: int, entries: int, rate: float,
+                  duration_s: float, arrival_batch: int, threads: int,
+                  max_batch: int, max_delay_s: float, device: bool,
+                  replicas: int, cache_size: int, zipf: float) -> dict:
+    """One offered-rate point: arrivals of ``arrival_batch`` lanes
+    land every ``arrival_batch / rate`` seconds on a fixed schedule;
+    latency is measured from the SCHEDULED instant, so dispatcher
+    backlog is latency (and past the admission bound, explicit shed)
+    instead of hidden load-generator throttling."""
+    import threading
+
+    import numpy as np
+
+    from ct_mapreduce_tpu.serve.batcher import Overloaded
+    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
+
+    sink = tmetrics.InMemSink()
+    prev = tmetrics.get_sink()
+    tmetrics.set_sink(sink)
+    oracle = make_oracle(agg, eh, entries, max_batch, max_delay_s,
+                         device, replicas, cache_size,
+                         max_queue_lanes=max(8 * max_batch, 4096))
+    interval = arrival_batch / rate
+    n_arrivals = max(1, int(duration_s / interval))
+    rng = np.random.default_rng(42)
+    sched = probe_indices(rng, n_arrivals * arrival_batch, entries,
+                          zipf).reshape(n_arrivals, arrival_batch)
+    lat: list[float] = []
+    shed_lanes = [0]
+    errors: list[str] = []
+    next_ix = [0]
+    ix_lock = threading.Lock()
+    t_start = time.perf_counter() + 0.05  # let every worker reach the gate
+
+    def worker() -> None:
+        while True:
+            with ix_lock:
+                i = next_ix[0]
+                next_ix[0] += 1
+            if i >= n_arrivals:
+                return
+            t_i = t_start + i * interval
+            now = time.perf_counter()
+            if now < t_i:
+                time.sleep(t_i - now)
+            js = sched[i]
+            items = [(0, eh, serial_bytes(int(j))) for j in js]
+            try:
+                res = oracle.query_raw(items)
+            except Overloaded:
+                shed_lanes.append(arrival_batch)
+                continue
+            lat.append(time.perf_counter() - t_i)  # GIL-atomic append
+            for r, j in zip(res, js):
+                if r[0] != (j < entries):
+                    errors.append(f"parity broke at {j}")
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = max(time.perf_counter() - t_start, 1e-9)
+    oracle.close()
+    tmetrics.set_sink(prev)
+    if errors:
+        raise ParityError(f"open-loop parity: {errors[:3]}")
+    snap = sink.snapshot()
+    counters = snap["counters"]
+    lanes = counters.get("serve.lanes", 0.0)
+    batches = counters.get("serve.batches", 0.0)
+    hits = counters.get("serve.cache_hit", 0.0)
+    misses = counters.get("serve.cache_miss", 0.0)
+    done = len(lat) * arrival_batch
+    offered = n_arrivals * arrival_batch
+    lat.sort()
+    n = len(lat)
+    return {
+        "offered_qps": round(rate, 1),
+        "achieved_qps": round(done / wall, 1),
+        "p50_ms": round(lat[n // 2] * 1e3, 3) if n else None,
+        "p99_ms": (round(lat[min(n - 1, int(0.99 * n))] * 1e3, 3)
+                   if n else None),
+        "shed_frac": round(sum(shed_lanes) / offered, 4),
+        "mean_batch_lanes": round(lanes / batches, 2) if batches else 0.0,
+        "cache_hit_rate": (round(hits / (hits + misses), 4)
+                           if hits + misses else 0.0),
+        "lanes_done": done,
+    }
+
+
+# -- verify-lane harness (moved from tools/stagecost.py) ------------------
+
+
+def verify_corpus(ops, n_uniq: int, n_keys: int):
+    """Mixed valid/invalid signature corpus: ``n_uniq`` unique
+    signatures tiled under ``n_keys`` distinct keys, 1/4 mutated, with
+    the pure-python host verdicts as the parity reference."""
+    import hashlib
+
+    import numpy as np
+
+    from ct_mapreduce_tpu.verify import host as vhost
+
+    c = ops.curve
+    nb = c.byte_len
+    uniq, key_xy = [], []
+    for i in range(n_uniq):
+        seed = f"sc-{c.name}-{i % n_keys}"
+        d = vhost.derive_scalar(seed, c)
+        q = vhost._point_mul(c, d, (c.gx, c.gy))
+        digest = hashlib.sha256(b"sc%d" % i).digest()
+        k = vhost.derive_nonce(seed, digest, c)
+        r, s_ = vhost.sign_ecdsa(c, digest, d, k)
+        if i % 4 == 0:
+            s_ ^= 1 << (i % 250)  # mutated lane
+        uniq.append((digest, r, s_, q[0], q[1]))
+        if i < n_keys:
+            key_xy.append(q)
+    href = [vhost.verify_ecdsa(c, dg, r, s_, x, y)
+            for dg, r, s_, x, y in uniq]
+
+    def bn(v):
+        return np.frombuffer(
+            (v % (1 << (8 * nb))).to_bytes(nb, "big"), np.uint8)
+
+    rows = {
+        "digest": np.stack([np.pad(
+            np.frombuffer(u[0], np.uint8), (nb - 32, 0))
+            for u in uniq]),
+        "r": np.stack([bn(u[1]) for u in uniq]),
+        "s": np.stack([bn(u[2]) for u in uniq]),
+        "qx": np.stack([bn(u[3]) for u in uniq]),
+        "qy": np.stack([bn(u[4]) for u in uniq]),
+    }
+    kidx = np.array([i % n_keys for i in range(n_uniq)], np.int32)
+    return rows, href, kidx, key_xy
+
+
+def verify_point(ops, width: int, window: int, corpus, reps: int = 3,
+                 verbose: bool = True) -> TimedReps:
+    """One (curve, width, window) verification point, bench
+    methodology: window 0 is the legacy Jacobian ladder; window > 0
+    measures the lane's steady state with G/Q tables device-resident
+    before the timed region (100% qtable hits — the production regime
+    under <100 log keys). Host-verdict parity asserted on every run,
+    warmup included; table-build wall folds into ``compile_s``."""
+    import jax as _jax
+    import numpy as np
+
+    from ct_mapreduce_tpu.ops import ecdsa
+
+    rows, href, kidx, key_xy = corpus
+    n_uniq = len(href)
+    n_keys = len(key_xy)
+    nl = ops.mod_p.nlimb
+    tiles = -(-width // n_uniq)
+    args = [np.tile(rows[k], (tiles, 1))[:width]
+            for k in ("digest", "r", "s", "qx", "qy")]
+    valid = np.ones((width,), bool)
+    key_idx = np.tile(kidx, tiles)[:width]
+    expect = (href * tiles)[:width]
+    t_tab = 0.0
+    if window == 0:
+        fn = ecdsa.jacobian_jit(ops)
+        call = lambda: fn(*args, valid)  # noqa: E731
+    else:
+        t0 = time.perf_counter()
+        gtab, _ = ecdsa.fixed_base_table(ops, window)
+        slots = max(ecdsa.MIN_QTABLE_SLOTS, n_keys)
+        qtab = np.zeros(
+            (slots, ops.nbits // window, 1 << window, 2, nl),
+            np.uint32)
+        for ki, (x, y) in enumerate(key_xy):
+            qtab[ki] = ecdsa.point_table_cached(ops, window, x, y)[0]
+        qtab_dev = _jax.device_put(qtab)
+        t_tab = time.perf_counter() - t0
+        if verbose:
+            say(f"  verify {ops.name} B={width} w={window}: "
+                f"tables {t_tab:.1f}s")
+        fn = ecdsa.windowed_jit(ops)
+        call = lambda: fn(*args, valid, key_idx,  # noqa: E731
+                          gtab, qtab_dev)
+
+    def check(out):
+        require(np.asarray(out).tolist() == expect,
+                f"verify {ops.name} B={width} w={window}: parity")
+
+    tr = timed_reps(lambda: np.asarray(call()), reps=reps, check=check)
+    tr.compile_s += t_tab  # table build is warmup-class wall too
+    return tr
+
+
+# -- staged-dispatch harness (moved from tools/stagecost.py) --------------
+
+
+def staged_dispatch_corpus(b: int = 1024, n_chunks: int = 8,
+                           pad_len: int = 1024):
+    """Fixed total work for the K-curve: ``n_chunks`` chunks of ``b``
+    walker lanes as host rows, plus the table capacity that holds
+    them (returned as a dict the sweep function consumes)."""
+    import numpy as np
+
+    from ct_mapreduce_tpu.utils import syncerts
+
+    tpl = syncerts.make_template(issuer_cn="Dispatch CA")
+    datas, lens = syncerts.build_device_batches(tpl, n_chunks, b, pad_len)
+    return {
+        "b": b, "n_chunks": n_chunks,
+        "datas": np.asarray(datas, np.uint8),
+        "lens": np.asarray(lens, np.int32),
+        "iidx": np.zeros((n_chunks, b), np.int32),
+        "valid": np.ones((n_chunks, b), bool),
+        "cap": 1 << max(14, (4 * n_chunks * b).bit_length()),
+    }
+
+
+def staged_dispatch_run(corpus: dict, k: int, mk_table=None):
+    """One K-point of the staged-envelope curve: the REAL production
+    shape per dispatch — host rows → one device_put → one
+    ingest_step_staged call. Returns (wall_s, packed readbacks, table
+    rows); callers assert byte parity of both against K=1."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ct_mapreduce_tpu.core import packing
+    from ct_mapreduce_tpu.ops import buckettable, pipeline
+
+    mk_table = mk_table or buckettable.make_table
+    n_chunks, b = corpus["n_chunks"], corpus["b"]
+    no_cn = np.zeros((0, 32), np.uint8)
+    no_cn_lens = np.zeros((0, 2), np.int32)
+    table = mk_table(corpus["cap"])
+    packs = []
+    t0 = time.perf_counter()
+    for g in range(n_chunks // k):
+        sl = slice(g * k, (g + 1) * k)
+        data = jax.device_put(corpus["datas"][sl])
+        table, out = pipeline.ingest_step_staged(
+            table, data, corpus["lens"][sl], corpus["iidx"][sl],
+            corpus["valid"][sl], jnp.int32(500_000),
+            jnp.int32(packing.DEFAULT_BASE_HOUR), no_cn, no_cn_lens)
+        packs.append(out.packed)
+    packed = np.concatenate(
+        [np.asarray(p) for p in packs], axis=0)  # sync point
+    rows = np.asarray(table.rows)
+    return time.perf_counter() - t0, packed, rows

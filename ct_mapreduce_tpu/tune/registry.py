@@ -1,0 +1,150 @@
+"""The knob inventory the autotuner sweeps — and the lint rule audits.
+
+Every ``Knob`` spec in the tree must appear here in exactly one of two
+tables, per section:
+
+- ``SWEEPABLE[section][knob]`` — the declared value ladder the search
+  driver walks (tune/measure.py providers consume these grids; the
+  full-scale ladders are what a device campaign sweeps, the ``smoke``
+  scale substitutes CPU-box-sized rungs — fewer points, and where the
+  full rungs themselves are device-sized, smaller ones, e.g.
+  ``verifyBatch`` [32]).
+- ``EXCLUDED[section][knob]`` — a justification string (>= 15 chars,
+  the ctmrlint.baseline discipline) for why the knob is NOT a
+  performance scalar worth sweeping: identity, policy, or semantic
+  choices that a measured curve must never overwrite.
+
+The config-parity ctmrlint rule diffs this file against the
+``_*_KNOBS`` declarations: a new Knob that lands in neither table
+fails the lint gate, so the autotuner can never silently go stale
+against the knob surface.
+
+This module is import-light on purpose (no jax, no subsystem
+imports): the lint rule parses it as AST, and the CLI/campaign import
+it before any device shows up.
+"""
+
+from __future__ import annotations
+
+# section -> (module holding the Knob tuple, attribute name). The show
+# CLI imports these lazily to render the resolved ladder.
+SECTIONS = {
+    "staging": ("ct_mapreduce_tpu.ingest.sync", "_STAGING_KNOBS"),
+    "serve": ("ct_mapreduce_tpu.serve.server", "_SERVE_KNOBS"),
+    "verify": ("ct_mapreduce_tpu.verify.lane", "_VERIFY_KNOBS"),
+    "fleet": ("ct_mapreduce_tpu.ingest.fleet", "_FLEET_KNOBS"),
+    "filter": ("ct_mapreduce_tpu.filter", "_FILTER_KNOBS"),
+    "distrib": ("ct_mapreduce_tpu.distrib", "_DISTRIB_KNOBS"),
+}
+
+# Declared ladders, coarse-to-fine in the order the search walks them.
+# Full-scale rungs target a device host; the smoke scale (measure.py)
+# swaps in CPU-box-sized rungs for the same knobs.
+SWEEPABLE = {
+    "staging": {
+        "chunksPerDispatch": [1, 2, 4, 8],
+        "stagingDepth": [1, 2, 3, 4],
+    },
+    "serve": {
+        "serveReplicas": [1, 2, 4],
+    },
+    "verify": {
+        "verifyBatch": [256, 1024, 4096],
+        "verifyPrecompWindow": [0, 2, 4, 8],
+    },
+    "fleet": {
+        "numWorkers": [1, 2, 4],
+    },
+    "filter": {
+        "filterStreamChunk": [0, 65536, 262144],
+        "filterFusedLanes": [0, 1024, 4096],
+        "filterCaptureSpillMB": [64, 256, 1024],
+    },
+    "distrib": {},
+}
+
+# Knobs the search must not touch, each with its justification.
+EXCLUDED = {
+    "staging": {},
+    "serve": {
+        "serveDevice": "capability toggle with automatic host "
+                       "fallback, not a swept performance scalar",
+        "serveCacheSize": "hit rate tracks the deployment's traffic "
+                          "skew, not platform speed — operator policy",
+    },
+    "verify": {
+        "verifySignatures": "workload on/off toggle: enables the "
+                            "lane, does not tune it",
+        "verifyLogKeys": "deployment key-list path — identity, not "
+                         "performance",
+        "verifyQTableSize": "LRU slots sized by the deployment's "
+                            "log-key count, not by device speed",
+    },
+    "fleet": {
+        "workerId": "worker identity within the fleet, never a "
+                    "performance knob",
+        "checkpointPeriod": "durability cadence is operator policy "
+                            "(data-loss budget), not throughput",
+        "coordinatorBackend": "fabric selection follows deployment "
+                              "topology (redis vs jax.distributed)",
+    },
+    "filter": {
+        "emitFilter": "workload on/off toggle: enables emission, "
+                      "does not tune it",
+        "filterPath": "artifact output location on the host "
+                      "filesystem — not a performance scalar",
+        "filterFpRate": "accuracy/size policy target; sweeping it "
+                        "would trade correctness budget for speed",
+        "filterCaptureSpillDir": "host filesystem location for the "
+                                 "capture spill, not a perf scalar",
+        "filterFormat": "wire-format semantic choice (fl01 compat vs "
+                        "fl02), clients depend on it",
+    },
+    "distrib": {
+        "distribHistory": "retention depth is storage/durability "
+                          "policy, not a measured rate",
+        "maxDeltaChain": "anchor cadence trades client wire bytes vs "
+                         "server storage — policy, not platform",
+    },
+}
+
+
+def audit() -> list:
+    """Cross-check the registry against the live Knob declarations
+    (the runtime twin of the lint rule — tests call this; the lint
+    rule re-derives the same diff from AST without importing jax).
+    Returns a list of human-readable problems, empty when clean."""
+    import importlib
+
+    problems = []
+    for section, (mod_name, attr) in SECTIONS.items():
+        try:
+            mod = importlib.import_module(mod_name)
+            knobs = getattr(mod, attr)
+        except Exception as err:  # pragma: no cover - import breakage
+            problems.append(f"{section}: cannot load {mod_name}.{attr}"
+                            f": {err}")
+            continue
+        swept = SWEEPABLE.get(section, {})
+        excl = EXCLUDED.get(section, {})
+        for knob in knobs:
+            hit_s, hit_e = knob.name in swept, knob.name in excl
+            if hit_s and hit_e:
+                problems.append(f"{section}.{knob.name}: both "
+                                "sweepable and excluded")
+            elif not (hit_s or hit_e):
+                problems.append(f"{section}.{knob.name}: in neither "
+                                "SWEEPABLE nor EXCLUDED")
+        names = {k.name for k in knobs}
+        for name in list(swept) + list(excl):
+            if name not in names:
+                problems.append(f"{section}.{name}: registered but no "
+                                "such Knob is declared")
+        for name, ladder in swept.items():
+            if not isinstance(ladder, list) or not ladder:
+                problems.append(f"{section}.{name}: empty ladder")
+        for name, why in excl.items():
+            if not isinstance(why, str) or len(why) < 15:
+                problems.append(f"{section}.{name}: exclusion needs a "
+                                ">=15 char justification")
+    return problems

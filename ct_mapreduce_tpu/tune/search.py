@@ -1,0 +1,184 @@
+"""Search driver: coordinate descent + successive halving on a knob grid.
+
+The knob spaces here are small (a handful of axes, each a short
+declared ladder — tune/registry.py), discrete, and expensive to probe
+(a point is a real device measurement with compile warmup). The shape
+that fits is the one XLA's own kernel autotuner uses: sweep one axis
+at a time from the current best (coordinate descent — the axes are
+close to separable: dispatch toll vs K, batcher occupancy vs
+replicas), and spend reps unevenly (successive halving — every
+candidate gets a cheap low-rep probe, only the surviving half gets the
+confirmatory high-rep evaluation that decides).
+
+Deterministic by construction: the only randomness is the per-sweep
+axis order drawn from ``random.Random(seed)``, evaluation results are
+cached by point, and nothing here reads a clock except to enforce the
+wall budget (budgets change *when the search stops*, never *what a
+given evaluation sequence returns*). No wall-clock value ever reaches
+emitted profile bytes — measured walls live only in provenance.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ct_mapreduce_tpu.telemetry import metrics
+
+
+@dataclass
+class EvalResult:
+    """One evaluation of one point: the metric's mean over ``reps``
+    runs, its spread, the wall it cost, and whether the point is
+    feasible at all (e.g. serve p99 blew the ingest-concurrent bound —
+    infeasible points are measured but can never win)."""
+
+    mean: float
+    std: float = 0.0
+    reps: int = 1
+    wall_s: float = 0.0
+    feasible: bool = True
+
+
+@dataclass
+class SearchResult:
+    best: dict
+    best_value: float  # the metric's mean at ``best`` (raw, unsigned)
+    # Every evaluate() call in order: (point, reps asked, EvalResult).
+    evaluations: list = field(default_factory=list)
+    evals_used: int = 0  # rep-weighted cost spent
+    wall_s: float = 0.0
+    budget_exhausted: bool = False
+    # knob -> [[value, mean], ...]: the measured 1-D slice through the
+    # final best point (the provenance curve the profile records).
+    curves: dict = field(default_factory=dict)
+
+
+def _key(grid: dict, point: dict) -> tuple:
+    return tuple(point[k] for k in grid)
+
+
+def coordinate_descent(
+        grid: dict, evaluate: Callable[[dict, int], EvalResult], *,
+        maximize: bool = True, seed: int = 0,
+        budget_evals: int = 0, budget_wall_s: float = 0.0,
+        reps: tuple = (1, 3), sweeps: int = 3,
+        start: Optional[dict] = None,
+        clock: Callable[[], float] = time.perf_counter) -> SearchResult:
+    """Find the best point of ``grid`` (knob -> declared value ladder)
+    under ``evaluate(point, reps) -> EvalResult``.
+
+    ``budget_evals`` bounds the rep-weighted evaluation count and
+    ``budget_wall_s`` the harness wall (0 = unbounded); when either
+    trips, the best point seen so far returns with
+    ``budget_exhausted=True``. ``reps = (low, high)`` is the
+    successive-halving split: every candidate on an axis gets a
+    ``low``-rep probe, the top half get the ``high``-rep confirmation.
+    """
+    if not grid or any(not v for v in grid.values()):
+        raise ValueError("grid must map every knob to a non-empty ladder")
+    rng = random.Random(seed)
+    reps_lo, reps_hi = int(reps[0]), int(reps[-1])
+    sign = 1.0 if maximize else -1.0
+    t_start = clock()
+    res = SearchResult(best={}, best_value=float("-inf"))
+    # point key -> (reps evaluated at, EvalResult); higher reps replace.
+    cache: dict[tuple, tuple[int, EvalResult]] = {}
+
+    def over_budget() -> bool:
+        if budget_evals and res.evals_used >= budget_evals:
+            return True
+        if budget_wall_s and clock() - t_start >= budget_wall_s:
+            return True
+        return False
+
+    def score(er: EvalResult) -> float:
+        return sign * er.mean if er.feasible else float("-inf")
+
+    def probe(point: dict, n: int) -> Optional[EvalResult]:
+        got = cache.get(_key(grid, point))
+        if got is not None and got[0] >= n:
+            return got[1]
+        if over_budget():
+            return None
+        er = evaluate(dict(point), n)
+        cache[_key(grid, point)] = (n, er)
+        res.evaluations.append((dict(point), n, er))
+        res.evals_used += n
+        metrics.incr_counter("tune", "evaluations")
+        metrics.add_sample("tune", "eval_s", value=er.wall_s)
+        return er
+
+    best_score = float("-inf")
+
+    def consider(point: dict, er: EvalResult) -> float:
+        nonlocal best_score
+        s = score(er)
+        if s > best_score:
+            best_score = s
+            res.best, res.best_value = dict(point), er.mean
+        return s
+
+    cur = dict(start) if start else {k: v[0] for k, v in grid.items()}
+    for k, ladder in grid.items():
+        if cur.get(k) not in ladder:
+            raise ValueError(f"start[{k}]={cur.get(k)!r} not on its "
+                             f"ladder {ladder}")
+    er = probe(cur, reps_hi)
+    if er is not None:
+        consider(cur, er)
+
+    for _ in range(max(1, int(sweeps))):
+        moved = False
+        axes = list(grid)
+        rng.shuffle(axes)
+        for axis in axes:
+            # Low-rep probe of every rung on this axis...
+            scored = []
+            for v in grid[axis]:
+                cand = dict(cur, **{axis: v})
+                er = probe(cand, reps_lo)
+                if er is None:
+                    res.budget_exhausted = True
+                    break
+                scored.append((score(er), v))
+            if res.budget_exhausted:
+                break
+            # ...then the surviving half gets the high-rep confirm.
+            scored.sort(key=lambda sv: sv[0], reverse=True)
+            keep = scored[:max(1, -(-len(scored) // 2))]
+            best_v, best_s = cur[axis], float("-inf")
+            for _, v in keep:
+                cand = dict(cur, **{axis: v})
+                er = probe(cand, reps_hi)
+                if er is None:
+                    res.budget_exhausted = True
+                    break
+                s = consider(cand, er)
+                if s > best_s:
+                    best_v, best_s = v, s
+            if res.budget_exhausted:
+                break
+            if best_s > float("-inf") and best_v != cur[axis]:
+                cur[axis] = best_v
+                moved = True
+        if res.budget_exhausted or not moved:
+            break
+
+    if not res.best:  # first probe already over budget
+        res.best, res.best_value = dict(cur), float("nan")
+    # Provenance curves: the measured 1-D slice through the best point
+    # along each axis (whatever rungs the search actually probed).
+    for axis, ladder in grid.items():
+        curve = []
+        for v in ladder:
+            got = cache.get(_key(grid, dict(res.best, **{axis: v})))
+            if got is not None:
+                curve.append([v, got[1].mean])
+        res.curves[axis] = curve
+    res.wall_s = clock() - t_start
+    if res.best_value == res.best_value:  # not NaN
+        metrics.set_gauge("tune", "best_value", value=res.best_value)
+    return res

@@ -265,3 +265,39 @@ def test_bench_smoke_verify_gate():
     assert out["smoke_verify_window"] > 0
     assert out["smoke_verify_qtable_misses"] == 2
     assert out["smoke_verify_qtable_hits"] > 0
+
+
+@pytest.mark.timeout(300)
+def test_bench_smoke_tune_gate(monkeypatch):
+    """Autotune leg (round 21): run_tune_smoke itself gates a REAL
+    scaled-down sweep (staging replay, open-loop serving, ECDSA
+    lanes) through the coordinate-descent driver, profile emission
+    (fingerprint + provenance), and the end-to-end load check —
+    resolve_staging / resolve_serve / resolve_verify returning the
+    tuned values through the profile layer alone. Here we pin that
+    every sweep really evaluated points and the emitted knobs sit on
+    the registry's declared surface. The winning points carry no
+    performance claim on this 1-core box (rounds-11/14 convention);
+    the real curves come from tools/campaign.py on a device host."""
+    import jax
+
+    if os.environ.get("CT_TPU_TESTS", "") == "":
+        jax.config.update("jax_platforms", "cpu")
+    import bench
+
+    from ct_mapreduce_tpu.tune.registry import SWEEPABLE
+
+    out = bench.run_tune_smoke()  # raises BenchError on any miss
+    assert out["metric"] == "ct_tune_smoke"
+    assert out["value"] > 0
+    assert out["smoke_tune_loaded"] == 1
+    assert os.path.exists(out["smoke_tune_profile_path"])
+    knobs = out["smoke_tune_knobs"]
+    assert set(knobs) == {"staging", "serve", "verify"}
+    for section, tuned in knobs.items():
+        assert tuned, f"empty tuned section {section}"
+        for name in tuned:
+            assert name in SWEEPABLE[section]
+    for name, st in out["smoke_tune_sweeps"].items():
+        assert st["evals"] >= 2, f"{name}: sweep did not search"
+        assert st["best_value"] > 0

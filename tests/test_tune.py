@@ -1,0 +1,425 @@
+"""Round-21 autotuner: search driver, profile emission + loading,
+campaign resume.
+
+Everything here is device-free by design: the search is exercised on
+synthetic surfaces, profile round-trips go through the real
+config/profile.py loader, and campaign resume uses ``--stub``
+subprocesses (deterministic synthetic evaluators, SIGKILL fault
+injection). The real-measurement path is gated by
+tests/test_bench_smoke.py::test_bench_smoke_tune_gate.
+"""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ct_mapreduce_tpu.config import profile as platprofile  # noqa: E402
+from ct_mapreduce_tpu.tune import emit, registry, search  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAMPAIGN = os.path.join(REPO, "tools", "campaign.py")
+
+GRID = {
+    "chunksPerDispatch": [1, 2, 4, 8],
+    "stagingDepth": [1, 2, 3, 4],
+    "batch": [256, 1024, 4096],
+}
+PLANTED = {"chunksPerDispatch": 4, "stagingDepth": 2, "batch": 1024}
+
+
+def surface(point):
+    """Separable bowl with the optimum at PLANTED."""
+    s = 0.0
+    for k, ladder in GRID.items():
+        s -= 100.0 * abs(ladder.index(point[k])
+                         - ladder.index(PLANTED[k]))
+    return 1000.0 + s
+
+
+# -- search driver --------------------------------------------------------
+
+
+def test_search_finds_planted_optimum():
+    calls = []
+
+    def evaluate(point, reps):
+        calls.append((dict(point), reps))
+        return search.EvalResult(mean=surface(point), reps=reps)
+
+    sr = search.coordinate_descent(GRID, evaluate, seed=7,
+                                   budget_evals=60)
+    assert sr.best == PLANTED
+    assert sr.best_value == 1000.0
+    assert not sr.budget_exhausted
+    assert sr.evals_used <= 60
+    # Coordinate descent beats exhaustive: 4*4*3 = 48 points, the
+    # search confirmed the optimum on a fraction of the rep budget.
+    assert len(calls) < 48
+    # Provenance curves cover every axis with measured points through
+    # the best.
+    assert set(sr.curves) == set(GRID)
+    for axis, curve in sr.curves.items():
+        assert curve, f"empty curve for {axis}"
+        vals = dict(curve)
+        assert vals[PLANTED[axis]] == 1000.0
+
+
+def test_search_finds_optimum_under_noise():
+    noise = random.Random(1234)  # deterministic, independent of seed
+
+    def evaluate(point, reps):
+        # Noise well under the 100-per-rung separation; more reps
+        # average it down like real reps would.
+        vals = [surface(point) + noise.gauss(0.0, 8.0)
+                for _ in range(reps)]
+        m = sum(vals) / len(vals)
+        return search.EvalResult(mean=m, reps=reps)
+
+    sr = search.coordinate_descent(GRID, evaluate, seed=3,
+                                   reps=(1, 5), budget_evals=200)
+    assert sr.best == PLANTED
+
+
+def test_search_deterministic_given_seed():
+    def run(seed):
+        order = []
+
+        def evaluate(point, reps):
+            order.append((tuple(sorted(point.items())), reps))
+            return search.EvalResult(mean=surface(point), reps=reps)
+
+        sr = search.coordinate_descent(GRID, evaluate, seed=seed)
+        return order, sr.best, sr.best_value
+
+    a = run(11)
+    b = run(11)
+    assert a == b  # identical evaluation sequence, not just best
+
+
+def test_search_budget_exhaustion_returns_partial():
+    def evaluate(point, reps):
+        return search.EvalResult(mean=surface(point), reps=reps)
+
+    sr = search.coordinate_descent(GRID, evaluate, seed=0,
+                                   budget_evals=4, reps=(1, 3))
+    assert sr.budget_exhausted
+    assert sr.evals_used <= 4 + 3  # last eval may straddle the line
+    assert sr.best  # never empty: the start point was confirmed
+
+
+def test_search_low_rep_probe_cannot_win():
+    """Successive halving: a point that looks great at the low-rep
+    probe but bad at the high-rep confirm must not end up best."""
+    decoy = {"chunksPerDispatch": 8, "stagingDepth": 4, "batch": 4096}
+
+    def evaluate(point, reps):
+        if dict(point) == decoy and reps < 3:
+            return search.EvalResult(mean=1e9, reps=reps)  # lying probe
+        return search.EvalResult(mean=surface(point), reps=reps)
+
+    sr = search.coordinate_descent(GRID, evaluate, seed=5,
+                                   reps=(1, 3))
+    assert sr.best != decoy
+    assert sr.best_value <= 1000.0
+
+
+def test_search_infeasible_everywhere_is_nan():
+    def evaluate(point, reps):
+        return search.EvalResult(mean=surface(point), reps=reps,
+                                 feasible=False)
+
+    sr = search.coordinate_descent(GRID, evaluate, seed=0)
+    assert sr.best_value != sr.best_value  # NaN: nothing confirmed
+    # ...and emission refuses to tune from it (no knobs, no NaN bytes).
+    m = _FakeMeasurement("staging")
+    prof = emit.build_profile([(m, sr)], platform="t",
+                              fingerprint={})
+    assert prof["knobs"] == {}
+    assert prof["provenance"]["staging"]["fake"]["best_value"] is None
+    assert b"NaN" not in emit.profile_bytes(prof)
+
+
+def test_search_rejects_bad_grid_and_start():
+    def evaluate(point, reps):
+        return search.EvalResult(mean=0.0, reps=reps)
+
+    with pytest.raises(ValueError):
+        search.coordinate_descent({}, evaluate)
+    with pytest.raises(ValueError):
+        search.coordinate_descent({"a": []}, evaluate)
+    with pytest.raises(ValueError):
+        search.coordinate_descent({"a": [1, 2]}, evaluate,
+                                  start={"a": 99})
+
+
+# -- profile emission + loading -------------------------------------------
+
+
+class _FakeMeasurement:
+    def __init__(self, section, name="fake", metric="rate",
+                 unit="1/s"):
+        self.section = section
+        self.name = name
+        self.metric = metric
+        self.unit = unit
+
+
+def _searched(best, value=123.0):
+    sr = search.SearchResult(best=dict(best), best_value=value)
+    sr.evaluations = [(dict(best), 3, None)]
+    sr.curves = {k: [[v, value]] for k, v in best.items()}
+    sr.wall_s = 1.5
+    return sr
+
+
+def test_profile_bytes_deterministic_and_knobs_filtered():
+    m = _FakeMeasurement("staging")
+    sr = _searched({"chunksPerDispatch": 4, "stagingDepth": 2,
+                    "maxBatch": 64})  # maxBatch: swept, NOT a knob
+    prof = emit.build_profile([(m, sr)], platform="test-host",
+                              fingerprint={"device_kind": "x"})
+    assert prof["knobs"] == {"staging": {"chunksPerDispatch": 4,
+                                         "stagingDepth": 2}}
+    prov = prof["provenance"]["staging"]["fake"]
+    assert prov["best_point"]["maxBatch"] == 64  # provenance keeps it
+    assert prov["evals"] == 1 and prov["reps"] == 3
+    assert emit.profile_bytes(prof) == emit.profile_bytes(prof)
+    # Same inputs -> same bytes (no timestamps, no env leakage).
+    prof2 = emit.build_profile([(m, sr)], platform="test-host",
+                               fingerprint={"device_kind": "x"})
+    assert emit.profile_bytes(prof) == emit.profile_bytes(prof2)
+
+
+def test_profile_roundtrip_through_loader(tmp_path):
+    m = _FakeMeasurement("staging")
+    sr = _searched({"chunksPerDispatch": 8, "stagingDepth": 3})
+    prof = emit.build_profile([(m, sr)], fingerprint={})
+    path = str(tmp_path / "p.json")
+    emit.write_profile(path, prof)
+    loaded = platprofile.load_profile(path)
+    assert loaded is not None
+    assert loaded["knobs"]["staging"]["chunksPerDispatch"] == 8
+    assert loaded["version"] == platprofile.PROFILE_VERSION
+
+
+def test_fingerprint_match_and_mismatch(tmp_path):
+    base = {"version": 1, "knobs": {"staging": {"stagingDepth": 3}}}
+    ok = str(tmp_path / "ok.json")
+    with open(ok, "w") as fh:
+        json.dump(dict(base, fingerprint={
+            "host_cores": os.cpu_count() or 1}), fh)
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        json.dump(dict(base, fingerprint={"host_cores": -1}), fh)
+    legacy = str(tmp_path / "legacy.json")
+    with open(legacy, "w") as fh:
+        json.dump(base, fh)  # round-18 profile: no fingerprint block
+    try:
+        assert platprofile.load_profile(ok) is not None
+        assert platprofile.load_profile(bad) is None  # warn + ignore
+        assert platprofile.load_profile(legacy) is not None
+        # Partial fingerprints compare only shared keys.
+        assert platprofile.fingerprint_matches({})
+        assert platprofile.fingerprint_matches(
+            {"unknown_key": "whatever"})
+        assert not platprofile.fingerprint_matches(
+            {"host_cores": -1}, {"host_cores": 4})
+    finally:
+        platprofile.invalidate_cache()
+
+
+def test_provenance_tolerant_load(tmp_path):
+    base = {"version": 1, "knobs": {"staging": {"stagingDepth": 2}}}
+    odd = str(tmp_path / "odd.json")
+    with open(odd, "w") as fh:
+        json.dump(dict(base, provenance={
+            "future_section": {"future_measure": {"anything": [1]}}},
+            extra_future_block=42), fh)
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        json.dump(dict(base, provenance=["not", "a", "dict"]), fh)
+    try:
+        loaded = platprofile.load_profile(odd)
+        assert loaded is not None  # unknown provenance content is fine
+        assert loaded["knobs"]["staging"]["stagingDepth"] == 2
+        assert platprofile.load_profile(bad) is None  # wrong shape
+    finally:
+        platprofile.invalidate_cache()
+
+
+def test_explain_section_layers(tmp_path, monkeypatch):
+    knobs = (
+        platprofile.Knob(name="alpha", env="CTMR_TEST_ALPHA",
+                         default=10,
+                         is_set=platprofile.pos_int),
+        platprofile.Knob(name="beta", env="", default=20,
+                         is_set=platprofile.pos_int),
+    )
+    path = str(tmp_path / "prof.json")
+    with open(path, "w") as fh:
+        json.dump({"version": 1,
+                   "knobs": {"testsec": {"alpha": 3, "beta": 4}}}, fh)
+    monkeypatch.delenv("CTMR_TEST_ALPHA", raising=False)
+    platprofile.set_active_profile(path)
+    platprofile.invalidate_cache()
+    try:
+        rows = platprofile.explain_section("testsec", knobs)
+        assert rows["alpha"] == {"value": 3, "layer": "profile"}
+        assert rows["beta"] == {"value": 4, "layer": "profile"}
+        monkeypatch.setenv("CTMR_TEST_ALPHA", "7")
+        rows = platprofile.explain_section("testsec", knobs)
+        assert rows["alpha"] == {"value": 7, "layer": "env"}
+        rows = platprofile.explain_section("testsec", knobs,
+                                           {"alpha": 9})
+        assert rows["alpha"] == {"value": 9, "layer": "explicit"}
+        platprofile.set_active_profile(None)
+        monkeypatch.delenv("CTMR_PLATFORM_PROFILE", raising=False)
+        monkeypatch.delenv("CTMR_TEST_ALPHA", raising=False)
+        rows = platprofile.explain_section("testsec", knobs)
+        assert rows["alpha"] == {"value": 10, "layer": "default"}
+        assert rows["beta"] == {"value": 20, "layer": "default"}
+        # explain and resolve agree (same ladder, one implementation).
+        assert {k: r["value"] for k, r in rows.items()} == \
+            platprofile.resolve_section("testsec", knobs, {})
+    finally:
+        platprofile.set_active_profile(None)
+        platprofile.invalidate_cache()
+
+
+# -- registry -------------------------------------------------------------
+
+
+def test_registry_covers_every_knob():
+    problems = registry.audit()
+    assert problems == []
+
+
+def test_registry_sections_match_measurements():
+    from ct_mapreduce_tpu.tune import measure
+
+    for name, m in measure.measurements().items():
+        assert m.section in registry.SECTIONS, name
+        grid = m.grid("smoke")
+        for knob in grid:
+            # Every swept PROFILE knob must be declared sweepable;
+            # extra measurement axes (maxBatch...) must NOT collide
+            # with any declared knob name of the section.
+            if knob in registry.SWEEPABLE.get(m.section, {}):
+                continue
+            assert knob not in registry.EXCLUDED.get(m.section, {}), \
+                f"{name} sweeps excluded knob {knob}"
+
+
+# -- campaign resume ------------------------------------------------------
+
+
+def _run_campaign(state, fault=None, timeout=120):
+    env = dict(os.environ)
+    env.pop("CTMR_CAMPAIGN_FAULT", None)
+    if fault:
+        env["CTMR_CAMPAIGN_FAULT"] = fault
+    return subprocess.run(
+        [sys.executable, CAMPAIGN, "--state", str(state), "--stub",
+         "--scale", "smoke"],
+        capture_output=True, text=True, env=env, timeout=timeout)
+
+
+@pytest.mark.timeout(300)
+def test_campaign_sigkill_resume(tmp_path):
+    state = tmp_path / "state"
+    # Kill mid-campaign: verify_sweep's work finishes but its
+    # checkpoint never lands (the worst preemption instant).
+    p = _run_campaign(state, fault="verify_sweep")
+    assert p.returncode == -signal.SIGKILL
+    done = sorted(f for f in os.listdir(state) if f.endswith(".json"))
+    assert done == ["leg-serve_openloop.json", "leg-staged_e2e.json"]
+    # Resume: completed legs skip, the killed leg reruns, the campaign
+    # finishes and emits the profile.
+    p = _run_campaign(state)
+    assert p.returncode == 0, p.stderr
+    assert p.stderr.count("checkpoint found") == 2
+    out = json.loads(p.stdout)
+    assert out["metric"] == "ct_device_campaign"
+    legs = out["legs"]
+    assert legs["staged_e2e"]["state"] == "resumed"
+    assert legs["serve_openloop"]["state"] == "resumed"
+    for leg in ("verify_sweep", "fleet_scale", "filter_device",
+                "tuned_profile"):
+        assert legs[leg]["state"] == "ran"
+    prof_path = legs["tuned_profile"]["profile_path"]
+    assert os.path.exists(prof_path)
+    # The emitted profile loads through the real config loader (the
+    # stub fingerprint has no host keys, so it matches everywhere).
+    loaded = platprofile.load_profile(prof_path)
+    platprofile.invalidate_cache()
+    assert loaded is not None
+    assert set(loaded["knobs"]) == {"staging", "serve", "verify",
+                                    "fleet", "filter"}
+    # A third run is a pure resume: every measurement leg skips.
+    p = _run_campaign(state)
+    assert p.returncode == 0, p.stderr
+    assert p.stderr.count("checkpoint found") == 5
+
+
+@pytest.mark.timeout(300)
+def test_campaign_deterministic_and_torn_checkpoint(tmp_path):
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    assert _run_campaign(a).returncode == 0
+    assert _run_campaign(b).returncode == 0
+    pa = open(a / "tuned_profile.json", "rb").read()
+    pb = open(b / "tuned_profile.json", "rb").read()
+    assert pa == pb  # stub campaign output is byte-deterministic
+    # A torn checkpoint (truncated JSON) must rerun its leg, not
+    # crash or be trusted.
+    with open(a / "leg-fleet_scale.json", "w") as fh:
+        fh.write('{"leg": "fleet_sc')
+    p = _run_campaign(a)
+    assert p.returncode == 0, p.stderr
+    assert "leg fleet_scale: sweeping" in p.stderr
+
+
+def test_campaign_legs_cover_the_five_device_runs():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import campaign
+    finally:
+        sys.path.pop(0)
+    from ct_mapreduce_tpu.tune import measure
+
+    # The consolidated campaign executes all five pending device runs
+    # (ROADMAP item 1) + the profile leg, in this order.
+    assert [n for n, _ in campaign.MEASURE_LEGS] == [
+        "staged_e2e", "serve_openloop", "verify_sweep", "fleet_scale",
+        "filter_device"]
+    assert campaign.LEGS[-1] == "tuned_profile"
+    have = measure.measurements()
+    sections = set()
+    for _leg, mname in campaign.MEASURE_LEGS:
+        assert mname in have
+        sections.add(have[mname].section)
+    assert sections == {"staging", "serve", "verify", "fleet",
+                        "filter"}
+
+
+# -- CLI ------------------------------------------------------------------
+
+
+def test_cli_show_renders_ladder(capsys):
+    from ct_mapreduce_tpu.tune import cli
+
+    rc = cli.main(["show"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for section in registry.SECTIONS:
+        assert f"[{section}]" in out
+    assert "chunksPerDispatch" in out
+    assert "(default; sweepable)" in out
+    assert "(default; excluded)" in out

@@ -1,0 +1,294 @@
+"""The ONE consolidated device campaign (ROADMAP item 1), resumable.
+
+Rounds 11–19 each left a sweep that needs a real accelerator host —
+the 1-core CPU box inverts every dispatch/batch curve (BENCHLOG rounds
+11–14: per-dispatch toll dominates, so K=1 and B=256 "win" where a
+device amortizes them). Device minutes are scarce and preemptible, so
+this script folds the five pending runs into one campaign that
+survives being killed at any instant:
+
+  staged_e2e      staged-queue chunksPerDispatch x stagingDepth e2e
+                  ingest rate (vs the 4.8–5M/s sweep rate target)
+  serve_openloop  open-loop serving sweep (replicas x max_batch x
+                  max_delay), p99 bounded under concurrent ingest
+  verify_sweep    CTMR_VERIFY_BATCH x precomp-window lanes/s
+  fleet_scale     fleet aggregate entries/s vs W (real worker
+                  subprocesses, serial-reference parity)
+  filter_device   device lane of the scaled filter build (fused
+                  scatter kernel vs its bit-identical NumPy twin)
+  tuned_profile   fold every leg's search result into one versioned
+                  tuned profile the config layer loads (tune/emit.py)
+
+Each leg runs the tune registry's measurement provider through the
+coordinate-descent driver and checkpoints its serialized result to
+``<state>/leg-<name>.json`` ATOMICALLY (tmp + fsync + rename) only
+after the leg completes. A rerun with the same ``--state`` dir skips
+every checkpointed leg and resumes at the first missing one — a
+preempted host pays only for unfinished work. The final leg rebuilds
+the profile purely from checkpoints, so it works even when every
+measurement leg ran in an earlier life of the process.
+
+Fault injection for the resume tests: ``CTMR_CAMPAIGN_FAULT=<leg>``
+SIGKILLs the process after that leg's work finishes but BEFORE its
+checkpoint lands — the worst preemption instant (work lost, leg must
+rerun). ``--stub`` swaps every evaluator for a deterministic synthetic
+surface (no jax, no devices) so the resume machinery is testable on
+any box in milliseconds.
+
+Output: one BENCH-style JSON line on stdout (legs, best points, the
+profile path), human progress on stderr.
+
+Usage:
+    python tools/campaign.py --state /var/tmp/ctmr-campaign \\
+        [--scale full] [--out tuned_profile.json] [--legs a,b] \\
+        [--seed 0] [--budget-wall-s 600] [--stub]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ct_mapreduce_tpu.tune.harness import say  # noqa: E402
+
+# Leg name -> measurement provider name (tune/measure.py). Order is
+# the execution order; tuned_profile always runs last.
+MEASURE_LEGS = (
+    ("staged_e2e", "staging_e2e"),
+    ("serve_openloop", "serve_openloop"),
+    ("verify_sweep", "verify_lanes"),
+    ("fleet_scale", "fleet_rate"),
+    ("filter_device", "filter_build"),
+)
+PROFILE_LEG = "tuned_profile"
+LEGS = tuple(n for n, _ in MEASURE_LEGS) + (PROFILE_LEG,)
+
+
+def _ckpt_path(state_dir: str, leg: str) -> str:
+    return os.path.join(state_dir, f"leg-{leg}.json")
+
+
+def _write_ckpt(state_dir: str, leg: str, payload: dict) -> None:
+    """Atomic checkpoint: a preempted write leaves the old state (or
+    nothing), never a torn file a resume would trust."""
+    path = _ckpt_path(state_dir, leg)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, sort_keys=True, indent=1)
+        fh.write("\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def _read_ckpt(state_dir: str, leg: str):
+    """A checkpoint counts only if it parses and matches the leg — a
+    torn or foreign file means the leg reruns."""
+    try:
+        with open(_ckpt_path(state_dir, leg)) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if payload.get("leg") != leg:
+        return None
+    return payload
+
+
+def _maybe_fault(leg: str) -> None:
+    if os.environ.get("CTMR_CAMPAIGN_FAULT") == leg:
+        say(f"# fault injection: SIGKILL before {leg} checkpoint")
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _serialize_search(m, sr) -> dict:
+    """The checkpointable slice of a SearchResult + its measurement's
+    identity — everything profile emission needs to run later from
+    disk alone."""
+    return {
+        "measurement": m.name,
+        "section": m.section,
+        "metric": m.metric,
+        "unit": m.unit,
+        "best": dict(sr.best),
+        "best_value": float(sr.best_value),
+        "curves": {k: [[v, float(y)] for v, y in c]
+                   for k, c in sr.curves.items()},
+        "eval_reps": [int(n) for _, n, _ in sr.evaluations],
+        "wall_s": float(sr.wall_s),
+        "budget_exhausted": bool(sr.budget_exhausted),
+    }
+
+
+class _CkptSearch:
+    """A SearchResult lookalike rebuilt from a checkpoint — carries
+    exactly the fields tune/emit.build_profile reads."""
+
+    def __init__(self, d: dict) -> None:
+        self.best = dict(d["best"])
+        self.best_value = float(d["best_value"])
+        self.curves = {k: [tuple(p) for p in c]
+                       for k, c in d["curves"].items()}
+        self.evaluations = [({}, n, None) for n in d["eval_reps"]]
+        self.wall_s = float(d["wall_s"])
+        self.budget_exhausted = bool(d["budget_exhausted"])
+
+
+class _CkptMeasurement:
+    def __init__(self, d: dict) -> None:
+        self.name = d["measurement"]
+        self.section = d["section"]
+        self.metric = d["metric"]
+        self.unit = d["unit"]
+
+
+def _stub_evaluator(leg: str, grid: dict):
+    """Deterministic synthetic surface for --stub: the planted optimum
+    is each ladder's middle rung, with a leg-keyed deterministic
+    ripple so different legs don't look identical. No clock, no RNG —
+    resume must replay byte-identically."""
+    from ct_mapreduce_tpu.tune.search import EvalResult
+
+    axes = {k: list(v) for k, v in grid.items()}
+
+    def evaluate(point: dict, reps: int) -> EvalResult:
+        score = 1000.0
+        for k, ladder in axes.items():
+            ix = ladder.index(point[k])
+            score -= 100.0 * abs(ix - len(ladder) // 2)
+        ripple = sum((ord(c) for c in leg), 0) % 7
+        return EvalResult(mean=score + ripple, reps=reps,
+                          wall_s=0.001 * reps)
+
+    return evaluate
+
+
+def _run_measure_leg(leg: str, measure_name: str, args) -> dict:
+    from ct_mapreduce_tpu.tune import measure, search
+
+    m = measure.get_measurement(measure_name)
+    grid = m.grid(args.scale)
+    if args.stub:
+        evaluate = _stub_evaluator(leg, grid)
+    else:
+        evaluate = m.evaluator(args.scale)
+    say(f"# leg {leg}: sweeping {measure_name} over "
+        f"{json.dumps(grid)}")
+    sr = search.coordinate_descent(
+        grid, evaluate, maximize=m.maximize, seed=args.seed,
+        budget_evals=args.budget_evals,
+        budget_wall_s=args.budget_wall_s,
+        reps=(args.reps_lo, args.reps_hi))
+    say(f"# leg {leg}: best {json.dumps(sr.best)} -> "
+        f"{sr.best_value:.1f} {m.unit} "
+        f"({len(sr.evaluations)} evals, {sr.wall_s:.1f}s"
+        f"{', budget exhausted' if sr.budget_exhausted else ''})")
+    return _serialize_search(m, sr)
+
+
+def _run_profile_leg(state_dir: str, args) -> dict:
+    """Assemble the tuned profile purely from leg checkpoints (this
+    leg must work when every measurement ran in a previous process)."""
+    from ct_mapreduce_tpu.tune import emit
+
+    results = []
+    for leg, _measure_name in MEASURE_LEGS:
+        ck = _read_ckpt(state_dir, leg)
+        if ck is None:
+            raise SystemExit(f"leg {leg} has no checkpoint; cannot "
+                             "emit the profile (rerun the campaign)")
+        d = ck["result"]
+        results.append((_CkptMeasurement(d), _CkptSearch(d)))
+    fp = {"stub": True} if args.stub else None
+    profile = emit.build_profile(results, platform=args.platform,
+                                 fingerprint=fp)
+    out = args.out or os.path.join(state_dir, "tuned_profile.json")
+    emit.write_profile(out, profile)
+    say(f"# leg {PROFILE_LEG}: wrote {out} "
+        f"(sections: {sorted(profile['knobs'])})")
+    return {"profile_path": os.path.abspath(out),
+            "knobs": profile["knobs"],
+            "platform": profile["platform"]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="one resumable device campaign: five sweeps + the "
+        "tuned profile")
+    ap.add_argument("--state", required=True,
+                    help="checkpoint directory (reuse it to resume)")
+    ap.add_argument("--scale", default="full",
+                    choices=("smoke", "full"))
+    ap.add_argument("--out", default="",
+                    help="tuned profile path "
+                    "(default <state>/tuned_profile.json)")
+    ap.add_argument("--platform", default="", help="profile label")
+    ap.add_argument("--legs", default="",
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--budget-evals", type=int, default=0)
+    ap.add_argument("--budget-wall-s", type=float, default=0.0,
+                    help="per-leg search wall budget (0 = unbounded)")
+    ap.add_argument("--reps-lo", type=int, default=1)
+    ap.add_argument("--reps-hi", type=int, default=3)
+    ap.add_argument("--stub", action="store_true",
+                    help="deterministic synthetic evaluators (no jax) "
+                    "— exercises search + checkpoints + resume only")
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.state, exist_ok=True)
+    wanted = set(args.legs.split(",")) - {""} or set(LEGS)
+    unknown = wanted - set(LEGS)
+    if unknown:
+        raise SystemExit(f"unknown legs {sorted(unknown)}; "
+                         f"have {list(LEGS)}")
+
+    status: dict = {}
+    for leg, measure_name in MEASURE_LEGS:
+        if leg not in wanted:
+            status[leg] = {"state": "skipped"}
+            continue
+        ck = _read_ckpt(args.state, leg)
+        if ck is not None:
+            say(f"# leg {leg}: checkpoint found, skipping")
+            status[leg] = {"state": "resumed",
+                           "best": ck["result"]["best"],
+                           "best_value": ck["result"]["best_value"],
+                           "unit": ck["result"]["unit"]}
+            continue
+        result = _run_measure_leg(leg, measure_name, args)
+        _maybe_fault(leg)
+        _write_ckpt(args.state, leg, {"leg": leg, "result": result})
+        status[leg] = {"state": "ran", "best": result["best"],
+                       "best_value": result["best_value"],
+                       "unit": result["unit"]}
+
+    if PROFILE_LEG in wanted:
+        # The profile is a pure function of the checkpoints — always
+        # re-derivable, so it reruns on every pass (cheap, and a
+        # resumed campaign picks up legs finished since last time).
+        result = _run_profile_leg(args.state, args)
+        _maybe_fault(PROFILE_LEG)
+        _write_ckpt(args.state, PROFILE_LEG,
+                    {"leg": PROFILE_LEG, "result": result})
+        status[PROFILE_LEG] = dict(result, state="ran")
+    else:
+        status[PROFILE_LEG] = {"state": "skipped"}
+
+    print(json.dumps({
+        "metric": "ct_device_campaign",
+        "scale": args.scale,
+        "stub": bool(args.stub),
+        "legs": status,
+        "state_dir": os.path.abspath(args.state),
+    }, sort_keys=True), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

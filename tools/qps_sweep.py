@@ -23,6 +23,12 @@ the round-10 numpy mirror), hot-serial cache (``--cache``; -1
 disables), with ``--zipf`` skewing the probe mix the way membership
 traffic actually looks (a hot working set, not uniform keys).
 
+The measurement machinery itself (table fill, oracle warmup, the two
+loop shapes, parity checks) lives in
+:mod:`ct_mapreduce_tpu.tune.harness` since round 21 — shared with the
+autotuner's ``serve_openloop`` provider so the sweep a human runs and
+the sweep the campaign runs are the same code.
+
 Usage:
     python tools/qps_sweep.py [--entries 200000] [--threads 8]
         [--duration 0.5] [--batches 16,64,256,1024] [--delays-ms 0.5,2,5]
@@ -40,217 +46,23 @@ import argparse
 import json
 import os
 import sys
-import threading
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np  # noqa: E402
+from ct_mapreduce_tpu.tune.harness import (  # noqa: E402
+    ParityError,
+    build_aggregator,
+    make_oracle,
+    probe_indices,
+    run_open_loop,
+    run_point,
+    serial_bytes,
+)
 
-
-def build_aggregator(entries: int, table_bits: int):
-    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
-    from ct_mapreduce_tpu.core import packing
-
-    agg = TpuAggregator(capacity=1 << table_bits, batch_size=4096,
-                        grow_at=0.0)
-    eh = agg.base_hour + 1000
-    serials = np.zeros((entries, packing.MAX_SERIAL_BYTES), np.uint8)
-    counters = np.arange(entries, dtype=np.uint64)
-    for i in range(8):
-        serials[:, 15 - i] = ((counters >> np.uint64(8 * i))
-                              & np.uint64(0xFF)).astype(np.uint8)
-    slen = np.full((entries,), 16, np.int64)
-    keys = packing.fingerprints_np(
-        np.zeros((entries,), np.int64), np.full((entries,), eh, np.int64),
-        serials, slen)
-    meta = np.full((entries,), packing.pack_meta(0, eh, agg.base_hour),
-                   np.uint32)
-    ovf = agg._bulk_reinsert(keys, meta)
-    if ovf:
-        raise SystemExit(f"table too small: {ovf} overflow rows; "
-                         "raise --table-bits")
-    agg._table_fill = entries
-    agg._device_written = True
-    return agg, eh
-
-
-def serial_bytes(j: int) -> bytes:
-    return b"\x00" * 8 + int(j).to_bytes(8, "big")
-
-
-def make_oracle(agg, eh: int, entries: int, max_batch: int,
-                max_delay_s: float, device: bool, replicas: int,
-                cache_size: int, max_queue_lanes: int = 0):
-    from ct_mapreduce_tpu.serve.server import MembershipOracle
-
-    oracle = MembershipOracle(
-        agg, max_batch=max_batch, max_delay_s=max_delay_s,
-        max_queue_lanes=max_queue_lanes or max(4 * max_batch, 1024),
-        max_staleness_s=60.0, device=device, replicas=replicas,
-        cache_size=cache_size if cache_size != 0 else -1)
-    oracle.snapshots.warm()  # captures + pins outside the timed window
-    # Warm the contains kernel at every pow2 width the batcher can
-    # form: compiles are per-shape and must not bill the timed window.
-    # Probe keys sit outside [0, 2*entries) so they never alias the
-    # sweep's probe domain through the cache.
-    w = 16
-    while w <= max_batch:
-        oracle.query_raw([(0, eh, serial_bytes(2 * entries + k))
-                          for k in range(w)])
-        w *= 2
-    return oracle
-
-
-def probe_indices(rng, n: int, entries: int, zipf: float) -> np.ndarray:
-    """Probe mix over [0, 2*entries): uniform (zipf=0 — half present,
-    half absent) or zipf-skewed ranks (a hot working set, the traffic
-    shape the hot-serial cache exists for)."""
-    if zipf <= 0:
-        return rng.integers(0, 2 * entries, size=n)
-    return np.minimum(rng.zipf(zipf, size=n) - 1, 2 * entries - 1)
-
-
-def run_point(agg, eh: int, entries: int, max_batch: int,
-              max_delay_s: float, threads: int, duration_s: float,
-              device: bool, replicas: int = 1,
-              cache_size: int = -1) -> dict:
-    from ct_mapreduce_tpu.serve.batcher import Overloaded
-    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
-
-    sink = tmetrics.InMemSink()
-    prev = tmetrics.get_sink()
-    tmetrics.set_sink(sink)
-    oracle = make_oracle(agg, eh, entries, max_batch, max_delay_s,
-                         device, replicas, cache_size)
-    lat: list[float] = []
-    shed = [0]
-    stop = time.perf_counter() + duration_s
-
-    def client(seed: int) -> None:
-        rng = np.random.default_rng(seed)
-        while time.perf_counter() < stop:
-            j = int(rng.integers(2 * entries))  # half present, half not
-            t0 = time.perf_counter()
-            try:
-                res = oracle.query_raw([(0, eh, serial_bytes(j))])
-            except Overloaded:
-                shed.append(1)
-                continue
-            lat.append(time.perf_counter() - t0)
-            assert res[0][0] == (j < entries), f"parity broke at {j}"
-
-    ts = [threading.Thread(target=client, args=(s,)) for s in range(threads)]
-    t0 = time.perf_counter()
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join()
-    wall = time.perf_counter() - t0
-    oracle.close()
-    tmetrics.set_sink(prev)
-    snap = sink.snapshot()
-    lanes = snap["counters"].get("serve.lanes", 0.0)
-    batches = snap["counters"].get("serve.batches", 0.0)
-    lat.sort()
-    n = len(lat)
-    return {
-        "max_batch": max_batch,
-        "max_delay_ms": round(max_delay_s * 1e3, 3),
-        "qps": round(n / wall, 1),
-        "p50_ms": round(lat[n // 2] * 1e3, 3) if n else None,
-        "p99_ms": (round(lat[min(n - 1, int(0.99 * n))] * 1e3, 3)
-                   if n else None),
-        "mean_batch_lanes": round(lanes / batches, 2) if batches else 0.0,
-        "shed": len(shed) - 1,
-        "queries": n,
-    }
-
-
-def run_open_loop(agg, eh: int, entries: int, rate: float,
-                  duration_s: float, arrival_batch: int, threads: int,
-                  max_batch: int, max_delay_s: float, device: bool,
-                  replicas: int, cache_size: int, zipf: float) -> dict:
-    """One offered-rate point: arrivals of ``arrival_batch`` lanes land
-    every ``arrival_batch / rate`` seconds on a fixed schedule;
-    latency is measured from the SCHEDULED instant, so dispatcher
-    backlog is latency, not hidden throttling."""
-    from ct_mapreduce_tpu.serve.batcher import Overloaded
-    from ct_mapreduce_tpu.telemetry import metrics as tmetrics
-
-    sink = tmetrics.InMemSink()
-    prev = tmetrics.get_sink()
-    tmetrics.set_sink(sink)
-    oracle = make_oracle(agg, eh, entries, max_batch, max_delay_s,
-                         device, replicas, cache_size,
-                         max_queue_lanes=max(8 * max_batch, 4096))
-    interval = arrival_batch / rate
-    n_arrivals = max(1, int(duration_s / interval))
-    rng = np.random.default_rng(42)
-    sched = probe_indices(rng, n_arrivals * arrival_batch, entries,
-                          zipf).reshape(n_arrivals, arrival_batch)
-    lat: list[float] = []
-    shed_lanes = [0]
-    errors: list[str] = []
-    next_ix = [0]
-    ix_lock = threading.Lock()
-    t_start = time.perf_counter() + 0.05  # let every worker reach the gate
-
-    def worker() -> None:
-        while True:
-            with ix_lock:
-                i = next_ix[0]
-                next_ix[0] += 1
-            if i >= n_arrivals:
-                return
-            t_i = t_start + i * interval
-            now = time.perf_counter()
-            if now < t_i:
-                time.sleep(t_i - now)
-            js = sched[i]
-            items = [(0, eh, serial_bytes(int(j))) for j in js]
-            try:
-                res = oracle.query_raw(items)
-            except Overloaded:
-                shed_lanes.append(arrival_batch)
-                continue
-            lat.append(time.perf_counter() - t_i)  # GIL-atomic append
-            for r, j in zip(res, js):
-                if r[0] != (j < entries):
-                    errors.append(f"parity broke at {j}")
-
-    ts = [threading.Thread(target=worker) for _ in range(threads)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join()
-    wall = max(time.perf_counter() - t_start, 1e-9)
-    oracle.close()
-    tmetrics.set_sink(prev)
-    if errors:
-        raise SystemExit(f"open-loop parity: {errors[:3]}")
-    snap = sink.snapshot()
-    counters = snap["counters"]
-    lanes = counters.get("serve.lanes", 0.0)
-    batches = counters.get("serve.batches", 0.0)
-    hits = counters.get("serve.cache_hit", 0.0)
-    misses = counters.get("serve.cache_miss", 0.0)
-    done = len(lat) * arrival_batch
-    offered = n_arrivals * arrival_batch
-    lat.sort()
-    n = len(lat)
-    return {
-        "offered_qps": round(rate, 1),
-        "achieved_qps": round(done / wall, 1),
-        "p50_ms": round(lat[n // 2] * 1e3, 3) if n else None,
-        "p99_ms": (round(lat[min(n - 1, int(0.99 * n))] * 1e3, 3)
-                   if n else None),
-        "shed_frac": round(sum(shed_lanes) / offered, 4),
-        "mean_batch_lanes": round(lanes / batches, 2) if batches else 0.0,
-        "cache_hit_rate": (round(hits / (hits + misses), 4)
-                           if hits + misses else 0.0),
-        "lanes_done": done,
-    }
+__all__ = [
+    "build_aggregator", "serial_bytes", "make_oracle", "probe_indices",
+    "run_point", "run_open_loop", "main",
+]
 
 
 def main() -> int:
@@ -296,28 +108,31 @@ def main() -> int:
           f"{args.replicas} replicas, cache {args.cache}, "
           f"zipf {args.zipf}", file=sys.stderr)
     rows = []
-    if args.open_loop:
-        for rate in (float(x) for x in args.rates.split(",")):
-            r = run_open_loop(
-                agg, eh, args.entries, rate, args.duration,
-                args.arrival_batch, args.threads, args.max_batch,
-                args.max_delay_ms / 1e3, device, args.replicas,
-                args.cache, args.zipf)
-            rows.append(r)
-            print(f"# {r}", file=sys.stderr)
-        hdr = ("offered_qps", "achieved_qps", "p50_ms", "p99_ms",
-               "shed_frac", "mean_batch_lanes", "cache_hit_rate")
-    else:
-        for mb in (int(x) for x in args.batches.split(",")):
-            for dly in (float(x) for x in args.delays_ms.split(",")):
-                r = run_point(agg, eh, args.entries, mb, dly / 1e3,
-                              args.threads, args.duration, device,
-                              replicas=args.replicas,
-                              cache_size=args.cache)
+    try:
+        if args.open_loop:
+            for rate in (float(x) for x in args.rates.split(",")):
+                r = run_open_loop(
+                    agg, eh, args.entries, rate, args.duration,
+                    args.arrival_batch, args.threads, args.max_batch,
+                    args.max_delay_ms / 1e3, device, args.replicas,
+                    args.cache, args.zipf)
                 rows.append(r)
                 print(f"# {r}", file=sys.stderr)
-        hdr = ("max_batch", "max_delay_ms", "qps", "p50_ms", "p99_ms",
-               "mean_batch_lanes", "shed")
+            hdr = ("offered_qps", "achieved_qps", "p50_ms", "p99_ms",
+                   "shed_frac", "mean_batch_lanes", "cache_hit_rate")
+        else:
+            for mb in (int(x) for x in args.batches.split(",")):
+                for dly in (float(x) for x in args.delays_ms.split(",")):
+                    r = run_point(agg, eh, args.entries, mb, dly / 1e3,
+                                  args.threads, args.duration, device,
+                                  replicas=args.replicas,
+                                  cache_size=args.cache)
+                    rows.append(r)
+                    print(f"# {r}", file=sys.stderr)
+            hdr = ("max_batch", "max_delay_ms", "qps", "p50_ms", "p99_ms",
+                   "mean_batch_lanes", "shed")
+    except ParityError as err:
+        raise SystemExit(str(err)) from err
     if args.json:
         json.dump(rows, sys.stdout, indent=2)
         print()

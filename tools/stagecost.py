@@ -356,44 +356,25 @@ def main() -> None:
         ingest_step_staged call — so the K=1 vs K=8 wall delta is
         exactly the per-dispatch Python + H2D + readback toll the
         staging ring amortizes. Byte parity (packed readbacks + final
-        table rows) is asserted against K=1 at every point."""
+        table rows) is asserted against K=1 at every point.
+
+        Since round 21 the corpus build and the per-K sweep live in
+        tune.harness (shared with the autotuner's staging provider)."""
+        from ct_mapreduce_tpu.tune import harness
+
         b = int(os.environ.get("CT_SC_DISPATCH_B", "1024"))
         n_chunks = 8
-        tpl_d = syncerts.make_template(issuer_cn="Dispatch CA")
-        datas_d, lens_d = syncerts.build_device_batches(
-            tpl_d, n_chunks, b, pad_len)
-        datas_np = np.asarray(datas_d, np.uint8)  # [8, B, L] host rows
-        lens_np = np.asarray(lens_d, np.int32)
-        iidx_np = np.zeros((n_chunks, b), np.int32)
-        valid_np = np.ones((n_chunks, b), bool)
-        dcap = 1 << max(14, (4 * n_chunks * b).bit_length())
+        corpus = harness.staged_dispatch_corpus(b=b, n_chunks=n_chunks,
+                                                pad_len=pad_len)
         say(f"  dispatch: {n_chunks} chunks x {b} lanes, pad {pad_len}")
-
-        def sweep(k):
-            table = mk_table(dcap)
-            packs = []
-            t0 = time.perf_counter()
-            for g in range(n_chunks // k):
-                sl = slice(g * k, (g + 1) * k)
-                data = jax.device_put(datas_np[sl])  # the H2D the
-                # staging ring ships per dispatch
-                table, out = pipeline.ingest_step_staged(
-                    table, data, lens_np[sl], iidx_np[sl], valid_np[sl],
-                    jnp.int32(now_hour),
-                    jnp.int32(packing.DEFAULT_BASE_HOUR),
-                    no_cn, no_cn_lens)
-                packs.append(out.packed)
-            packed = np.concatenate(
-                [np.asarray(p) for p in packs], axis=0)  # sync point
-            rows = np.asarray(table.rows)
-            return time.perf_counter() - t0, packed, rows
 
         base = None
         for k in (1, 2, 4, 8):
-            sweep(k)  # compile + warmup
+            harness.staged_dispatch_run(corpus, k, mk_table=mk_table)
             best = None
             for _ in range(3):
-                dt, packed, rows = sweep(k)
+                dt, packed, rows = harness.staged_dispatch_run(
+                    corpus, k, mk_table=mk_table)
                 best = dt if best is None else min(best, dt)
             if base is None:
                 base = (packed, rows, best)
@@ -422,102 +403,32 @@ def main() -> None:
         signatures under 7 distinct keys (3/4 valid, 1/4 mutated) so
         host-side generation stays cheap at B=4096.
 
+        Since round 21 the corpus build and the per-point measurement
+        (tables, warmup, best-of-3, host parity every run) live in
+        tune.harness — shared with the autotuner's verify provider.
+
         Env: CT_SC_VERIFY_B (widths, default 256,1024,4096),
         CT_SC_VERIFY_W (windows, default 0,2,4,8; 0 = legacy ladder),
         CT_SC_VERIFY_P384_B (P-384 widths, default 256; empty
         disables), CT_SC_VERIFY_P384_W (default 0,8)."""
-        import hashlib
-
-        import jax as _jax
-
         from ct_mapreduce_tpu.ops import ecdsa
-        from ct_mapreduce_tpu.verify import host as vhost
-
-        def corpus(ops, n_uniq, n_keys):
-            c = ops.curve
-            nb = c.byte_len
-            uniq, key_xy = [], []
-            for i in range(n_uniq):
-                seed = f"sc-{c.name}-{i % n_keys}"
-                d = vhost.derive_scalar(seed, c)
-                q = vhost._point_mul(c, d, (c.gx, c.gy))
-                digest = hashlib.sha256(b"sc%d" % i).digest()
-                k = vhost.derive_nonce(seed, digest, c)
-                r, s_ = vhost.sign_ecdsa(c, digest, d, k)
-                if i % 4 == 0:
-                    s_ ^= 1 << (i % 250)  # mutated lane
-                uniq.append((digest, r, s_, q[0], q[1]))
-                if i < n_keys:
-                    key_xy.append(q)
-            href = [vhost.verify_ecdsa(c, dg, r, s_, x, y)
-                    for dg, r, s_, x, y in uniq]
-
-            def bn(v):
-                return np.frombuffer(
-                    (v % (1 << (8 * nb))).to_bytes(nb, "big"), np.uint8)
-
-            rows = {
-                "digest": np.stack([np.pad(
-                    np.frombuffer(u[0], np.uint8), (nb - 32, 0))
-                    for u in uniq]),
-                "r": np.stack([bn(u[1]) for u in uniq]),
-                "s": np.stack([bn(u[2]) for u in uniq]),
-                "qx": np.stack([bn(u[3]) for u in uniq]),
-                "qy": np.stack([bn(u[4]) for u in uniq]),
-            }
-            kidx = np.array([i % n_keys for i in range(n_uniq)],
-                            np.int32)
-            return rows, href, kidx, key_xy
+        from ct_mapreduce_tpu.tune import harness
 
         def sweep(ops, widths, windows, n_uniq=64, n_keys=7):
-            rows, href, kidx, key_xy = corpus(ops, n_uniq, n_keys)
-            nl = ops.mod_p.nlimb
+            corpus = harness.verify_corpus(ops, n_uniq, n_keys)
             for w in widths:
-                reps = -(-w // n_uniq)
-                args = [np.tile(rows[k], (reps, 1))[:w]
-                        for k in ("digest", "r", "s", "qx", "qy")]
-                valid = np.ones((w,), bool)
-                key_idx = np.tile(kidx, reps)[:w]
-                expect = (href * reps)[:w]
                 base_ns = None
                 for win in windows:
-                    if win == 0:
-                        fn = ecdsa.jacobian_jit(ops)
-                        call = lambda: fn(*args, valid)  # noqa: E731
-                    else:
-                        t0 = time.perf_counter()
-                        gtab, _ = ecdsa.fixed_base_table(ops, win)
-                        slots = max(ecdsa.MIN_QTABLE_SLOTS, n_keys)
-                        qtab = np.zeros(
-                            (slots, ops.nbits // win, 1 << win, 2, nl),
-                            np.uint32)
-                        for ki, (x, y) in enumerate(key_xy):
-                            qtab[ki] = ecdsa.point_table_cached(
-                                ops, win, x, y)[0]
-                        qtab_dev = _jax.device_put(qtab)
-                        say(f"  verify {ops.name} B={w} w={win}: "
-                            f"tables {time.perf_counter() - t0:.1f}s")
-                        fn = ecdsa.windowed_jit(ops)
-                        call = lambda: fn(*args, valid, key_idx,  # noqa: E731,B023
-                                          gtab, qtab_dev)
-                    t0 = time.perf_counter()
-                    out = np.asarray(call())
+                    tr = harness.verify_point(ops, w, win, corpus,
+                                              reps=3)
                     say(f"  verify {ops.name} B={w} w={win}: "
-                        f"compile+warmup {time.perf_counter() - t0:.1f}s")
-                    assert out.tolist() == expect, \
-                        f"verify {ops.name} B={w} w={win}: parity"
-                    best = None
-                    for _ in range(3):
-                        t0 = time.perf_counter()
-                        out = np.asarray(call())
-                        dt = time.perf_counter() - t0
-                        best = dt if best is None else min(best, dt)
-                    assert out.tolist() == expect
-                    ns = best / w * 1e9
+                        f"compile+warmup {tr.compile_s:.1f}s")
+                    ns = tr.best / w * 1e9
                     if base_ns is None:
                         base_ns = ns
                     say(f"verify  {ops.name} B={w:<5d} w={win:<2d} "
-                        f"{best * 1e3:9.2f} ms/batch  {ns:12.1f} ns/sig"
+                        f"{tr.best * 1e3:9.2f} ms/batch  "
+                        f"{ns:12.1f} ns/sig"
                         f"  ({base_ns / ns:.2f}x vs w={windows[0]}, "
                         f"parity exact)")
 
