@@ -2453,6 +2453,118 @@ def run_fleet_smoke() -> dict:
     }
 
 
+def run_ckpt_smoke() -> dict:
+    """CT_BENCH_SMOKE checkpoint leg (round 22): the incremental-
+    checkpoint plane (CTMRCK02, agg/ckpt.py) at a CPU-box scale —
+    structure and parity gates carried in full, the 10⁷-scale ≥5×
+    headline lives in the stagecost run recorded in BENCHLOG:
+
+      (1) O(churn) TICK: after a base anchor, a 1%-churn epoch tick
+          must save ≥5× faster than the full ck01 save of the same
+          fixture (the real margin is far larger; 5× keeps the gate
+          honest on noisy CI boxes);
+      (2) RESTORE PARITY EXACT: base + chain replay digests
+          (tune.harness.ckpt_state_digest) identical to the live
+          writer AND to a ck01 oracle save of the same state;
+      (3) CHAIN BOUNDED: ckptMaxChain segments force a compaction
+          anchor (fresh base, chain reset, stale segments dropped).
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+    from ct_mapreduce_tpu.tune import harness
+
+    entries = int(os.environ.get("CT_BENCH_SMOKE_CKPT_ENTRIES",
+                                 "100000"))
+    bits = 18
+    agg, eh = harness.build_aggregator(entries, bits)
+    tmp = tempfile.mkdtemp(prefix="bench-ckpt.")
+    try:
+        p01 = os.path.join(tmp, "ck01.npz")
+        agg.configure_checkpointing(mode="ck01")
+        t0 = time.perf_counter()
+        agg.save_checkpoint(p01)
+        full_s = time.perf_counter() - t0
+
+        p02 = os.path.join(tmp, "ck02.npz")
+        agg.configure_checkpointing(mode="ck02", max_chain=2)
+        agg.save_checkpoint(p02)  # base anchor
+        nch = max(1, entries // 100)
+        start = entries
+        harness.ckpt_churn(agg, eh, nch, start)
+        start += nch
+        t0 = time.perf_counter()
+        agg.save_checkpoint(p02)
+        tick_s = time.perf_counter() - t0
+        speedup = full_s / tick_s
+        if agg._ckpt_chain_len != 1:
+            raise BenchError(
+                f"ckpt smoke: 1%-churn tick did not append a segment "
+                f"(chain {agg._ckpt_chain_len})")
+        if speedup < 5.0:
+            raise BenchError(
+                f"ckpt smoke: 1%-churn tick {tick_s * 1e3:.1f} ms is "
+                f"only {speedup:.1f}x faster than the {full_s * 1e3:.1f}"
+                " ms full save (gate: >=5x)")
+
+        # (2) parity: chain restore == live writer == ck01 oracle.
+        want = harness.ckpt_state_digest(agg)
+        r = TpuAggregator(capacity=1 << bits, batch_size=4096,
+                          grow_at=0.0)
+        t0 = time.perf_counter()
+        r.load_checkpoint(p02)
+        restore_s = time.perf_counter() - t0
+        if harness.ckpt_state_digest(r) != want:
+            raise BenchError("ckpt smoke: chain restore diverged "
+                             "from the writer state")
+        oracle_p = os.path.join(tmp, "oracle.npz")
+        agg.configure_checkpointing(mode="ck01")
+        agg.save_checkpoint(oracle_p)
+        o = TpuAggregator(capacity=1 << bits, batch_size=4096,
+                          grow_at=0.0)
+        o.load_checkpoint(oracle_p)
+        if harness.ckpt_state_digest(o) != want:
+            raise BenchError("ckpt smoke: ck01 oracle restore "
+                             "diverged from the writer state")
+
+        # (3) chain bound: maxChain=2 → third tick anchors.
+        agg.configure_checkpointing(mode="ck02", max_chain=2)
+        anchored = False
+        for _ in range(3):
+            harness.ckpt_churn(agg, eh, nch, start)
+            start += nch
+            agg.save_checkpoint(p02)
+            if agg._ckpt_chain_len == 0:
+                anchored = True
+        if not anchored or agg._ckpt_chain_len > 2:
+            raise BenchError(
+                f"ckpt smoke: chain not bounded by maxChain=2 "
+                f"(chain {agg._ckpt_chain_len}, anchored={anchored})")
+    except harness.ParityError as err:
+        raise BenchError(f"ckpt smoke: {err}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    log(f"ckpt smoke: full {full_s * 1e3:.1f} ms vs 1%-churn tick "
+        f"{tick_s * 1e3:.1f} ms ({speedup:.1f}x), restore "
+        f"{restore_s * 1e3:.1f} ms, parity exact, chain bounded")
+    return {
+        "metric": "ct_ckpt_smoke",
+        "value": round(speedup, 2),
+        "unit": "x_vs_full_save",
+        "smoke_ckpt_entries": entries,
+        "smoke_ckpt_full_ms": round(full_s * 1e3, 1),
+        "smoke_ckpt_tick_ms": round(tick_s * 1e3, 1),
+        "smoke_ckpt_restore_ms": round(restore_s * 1e3, 1),
+        "smoke_ckpt_parity": 1,
+        "smoke_ckpt_chain_bounded": 1,
+    }
+
+
 def run_tune_smoke() -> dict:
     """CT_BENCH_SMOKE autotune leg (round 21): a scaled-down REAL
     sweep through the whole tune pipeline — measurement providers →

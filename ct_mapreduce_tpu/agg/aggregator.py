@@ -50,6 +50,7 @@ from urllib.parse import urlparse
 
 import numpy as np
 
+from ct_mapreduce_tpu.agg import ckpt
 from ct_mapreduce_tpu.core import der as hostder
 from ct_mapreduce_tpu.core import packing
 from ct_mapreduce_tpu.core.types import ExpDate, Issuer
@@ -120,6 +121,13 @@ class IssuerRegistry:
 
     def index_of_issuer_id(self, issuer_id: str) -> Optional[int]:
         return self._by_issuer_id.get(issuer_id)
+
+    def ids_from(self, start: int) -> list[str]:
+        """Issuer-id strings for indices >= ``start``, in index order —
+        the registry's append-only suffix since a shadow length was
+        taken (CTMRCK02 segment diffs)."""
+        with self._lock:
+            return [iss.id() for iss in self._issuers[start:]]
 
     def issuer_at(self, idx: int) -> Issuer:
         return self._issuers[idx]
@@ -574,6 +582,36 @@ class TpuAggregator:
             "filtered_cn": 0, "host_lane": 0, "parse_errors": 0, "overflow": 0,
             "dispatch_spill": 0,
         }
+        # Serializes checkpoint-time filter emission, which runs OUTSIDE
+        # _save_lock (the checkpoint bytes land atomically before the
+        # build starts; a multi-second scaled build must not block the
+        # fleet-cadence save fan-out). GroupBuildCache is not
+        # thread-safe, so overlapping emissions still serialize here.
+        self._emit_lock = threading.Lock()
+        # Incremental checkpoints (CTMRCK02, agg/ckpt.py): the per-tick
+        # dirty log the fold paths append to under _fold_lock, armed
+        # only after a save/load established a durable base at
+        # _ckpt_path (non-checkpointing runs record nothing). The save
+        # path turns the log into one delta segment; any event that
+        # breaks O(churn) replayability (grow/rehash, serial-less
+        # folds, a recorded/inserted count mismatch, segment budget)
+        # poisons the log and forces the next save to anchor (fresh
+        # full base).
+        self._ckpt_knobs = None
+        self._ckpt_track = False
+        self._ckpt_dirty_lost = False
+        self._ckpt_rows: list[tuple[int, int, bytes]] = []
+        self._ckpt_host_adds: list[tuple[int, int, bytes]] = []
+        self._ckpt_row_bytes = 0
+        self._ckpt_dev_inserted = 0
+        self._ckpt_path = ""
+        self._ckpt_base_sha = ""
+        self._ckpt_tip_token = ""
+        self._ckpt_chain_len = 0
+        # Snapshot-diff shadows from the last durable tick for the
+        # small O(issuers) structures (registry length, totals/verify
+        # vectors, CRL/DN sets).
+        self._ckpt_shadow: Optional[dict] = None
 
     # -- state hooks (overridden by the mesh-sharded subclass) -----------
     def _layout_capacity_floor(self, cap: int) -> int:
@@ -752,6 +790,11 @@ class TpuAggregator:
                 cap = min(cap * 2, self.max_capacity)
             self.capacity = actual
         self._table_fill = len(keys)
+        # A rehash changes the table's capacity/topology: a delta chain
+        # replayed onto the pre-grow base would restore the OLD
+        # capacity, diverging from what a full save would record — the
+        # next checkpoint must anchor.
+        self._ckpt_mark_dirty_lost("table grow")
         incr_counter("aggregator", "table_grow")
         set_gauge("aggregator", "table_load",
                   value=self._table_fill / self.capacity)
@@ -859,6 +902,10 @@ class TpuAggregator:
                     file=sys.stderr,
                 )
         self.want_serials = True
+        # Capture state changed out-of-band of the dirty log (seeding,
+        # ring adoption): segments record capture *additions* only, so
+        # the next checkpoint must anchor to carry the new baseline.
+        self._ckpt_mark_dirty_lost("capture reconfigured")
 
     def configure_filter_emission(self, path: str,
                                   fp_rate: float = 0.01,
@@ -906,6 +953,98 @@ class TpuAggregator:
         if self.filter_capture_hashes is None:
             return None
         return dict(self.filter_capture_hashes)
+
+    # -- incremental checkpoints (CTMRCK02, agg/ckpt.py) -----------------
+    def configure_checkpointing(self, mode: str = "",
+                                max_chain: int = 0,
+                                segment_budget_mb: int = 0) -> None:
+        """Pin the checkpoint-plane knobs explicitly (the
+        ``checkpointMode``/``ckptMaxChain``/``ckptSegmentBudgetMB``
+        directives). Unset values fall through the knob ladder
+        (CTMR_* env > platformProfile > default), which also applies
+        lazily at the first save when this is never called."""
+        self._ckpt_knobs = ckpt.resolve_ckpt(
+            mode=mode, max_chain=max_chain,
+            segment_budget_mb=segment_budget_mb)
+
+    def _ckpt_resolved(self) -> "ckpt.CkptKnobs":
+        if self._ckpt_knobs is None:
+            self._ckpt_knobs = ckpt.resolve_ckpt()
+        return self._ckpt_knobs
+
+    def _ckpt_record_row(self, issuer_idx: int, exp_hour: int,
+                         serial: bytes) -> None:
+        """Dirty-log one device-table insert (fold paths, under the
+        fold lock). No-op until a save/load arms tracking."""
+        if not self._ckpt_track or self._ckpt_dirty_lost:
+            return
+        self._ckpt_rows.append((issuer_idx, exp_hour, serial))
+        self._ckpt_note_bytes(len(serial))
+
+    def _ckpt_record_host(self, issuer_idx: int, exp_hour: int,
+                          serial: bytes) -> None:
+        """Dirty-log one host-lane first-seen serial (under the fold
+        lock; _host_dedup already deduplicated it)."""
+        if not self._ckpt_track or self._ckpt_dirty_lost:
+            return
+        self._ckpt_host_adds.append((issuer_idx, exp_hour, serial))
+        self._ckpt_note_bytes(len(serial))
+
+    def _ckpt_note_bytes(self, serial_len: int) -> None:
+        self._ckpt_row_bytes += serial_len + ckpt.REC.size
+        budget = self._ckpt_resolved().segment_budget_mb << 20
+        if self._ckpt_row_bytes > budget:
+            # A tick whose churn rivals the corpus gains nothing from
+            # a delta; cap the log so memory stays bounded.
+            self._ckpt_mark_dirty_lost("segment budget exceeded")
+
+    def _ckpt_note_inserted(self, n: int) -> None:
+        if self._ckpt_track and not self._ckpt_dirty_lost:
+            self._ckpt_dev_inserted += n
+
+    def _ckpt_mark_dirty_lost(self, why: str) -> None:
+        """Poison the dirty log: the next save anchors (full base).
+        Recording stops and the log drops immediately — correctness
+        never depends on a poisoned log's contents."""
+        if not self._ckpt_track or self._ckpt_dirty_lost:
+            return
+        self._ckpt_dirty_lost = True
+        self._ckpt_clear_log()
+        incr_counter("ckpt", "dirty_lost")
+        print(f"checkpoint dirty log dropped ({why}): next save "
+              "writes a full base", file=sys.stderr)
+
+    def _ckpt_clear_log(self) -> None:
+        self._ckpt_rows = []
+        self._ckpt_host_adds = []
+        self._ckpt_row_bytes = 0
+        self._ckpt_dev_inserted = 0
+
+    def _ckpt_take_shadow(self) -> dict:
+        """Copies of the small O(issuers) structures at a durable
+        tick, diffed against at the next segment save. Caller holds
+        the fold lock (or is otherwise quiesced)."""
+        return {
+            "registry_len": len(self.registry),
+            "issuer_totals": self.issuer_totals.copy(),
+            "verify_verified": self.verify_verified.copy(),
+            "verify_failed": self.verify_failed.copy(),
+            "crl": {i: set(s) for i, s in sorted(self.crl_sets.items())},
+            "dn": {i: set(s) for i, s in sorted(self.dn_sets.items())},
+        }
+
+    def _ckpt_arm(self, path: str, base_sha: str, tip_token: str,
+                  chain_len: int) -> None:
+        """Arm dirty tracking against a durable tick at ``path``."""
+        self._ckpt_path = path
+        self._ckpt_base_sha = base_sha
+        self._ckpt_tip_token = tip_token
+        self._ckpt_chain_len = chain_len
+        self._ckpt_track = True
+        self._ckpt_dirty_lost = False
+        self._ckpt_clear_log()
+        self._ckpt_shadow = self._ckpt_take_shadow()
+        set_gauge("ckpt", "chain_length", value=float(chain_len))
 
     # -- ingest ----------------------------------------------------------
     def ingest(self, entries: list[tuple[bytes, bytes]]) -> IngestResult:
@@ -1361,6 +1500,9 @@ class TpuAggregator:
                 if wu[p_]:
                     key = (int(plan.issuer_idx[p_]),
                            int(sc.not_after_hour[p_]))
+                    # Dirty-log PRE-guard (see _consume_out): the
+                    # device table holds this key either way.
+                    self._ckpt_record_row(key[0], key[1], sb)
                     if sb in self.host_serials.get(key, ()):
                         # Cross-encoding guard (see module docstring).
                         wu[p_] = False
@@ -1370,6 +1512,8 @@ class TpuAggregator:
                         self._capture_serial(key[0], key[1], sb)
         else:
             res.was_unknown[wu] = True
+            if dev_inserted:
+                self._ckpt_mark_dirty_lost("serial-less fold")
         ksel = np.nonzero(res.was_unknown[:n])[0]
         if ksel.size:
             self._accumulate_metadata_lanes(
@@ -1383,6 +1527,7 @@ class TpuAggregator:
         self.metrics["inserted"] += dev_unknown
         self.metrics["known"] += max(dev_known, 0)
         self._table_fill += dev_inserted
+        self._ckpt_note_inserted(dev_inserted)
         set_gauge("aggregator", "table_load",
                   value=self._table_fill / self.capacity)
 
@@ -1488,6 +1633,11 @@ class TpuAggregator:
                 if wu[l_]:
                     # Cross-encoding guard (see module docstring).
                     key = (int(batch.issuer_idx[l_]), int(nah[l_]))
+                    # Dirty-log the row PRE-guard: the device inserted
+                    # this key whether or not the guard flips the
+                    # report, and the delta segment mirrors table
+                    # slots, not report semantics.
+                    self._ckpt_record_row(key[0], key[1], sb)
                     if sb in self.host_serials.get(key, ()):
                         wu[l_] = False
                         # Keep the running per-issuer gauge consistent
@@ -1503,6 +1653,10 @@ class TpuAggregator:
             # needed here. was_unknown may over-report on the
             # pathological host-then-device duplicate; counts cannot.
             res.was_unknown[kp[wu[kl]]] = True
+            if dev_inserted:
+                # No serial bytes → those inserts cannot be dirty-
+                # logged; the next checkpoint must anchor.
+                self._ckpt_mark_dirty_lost("serial-less fold")
         ksel = np.where(res.was_unknown[pos_arr])[0]
         if ksel.size:
             lanes_arr = np.asarray(lanes)
@@ -1524,6 +1678,7 @@ class TpuAggregator:
         self.metrics["inserted"] += dev_unknown
         self.metrics["known"] += max(dev_known, 0)
         self._table_fill += dev_inserted
+        self._ckpt_note_inserted(dev_inserted)
         set_gauge("aggregator", "table_load",
                   value=self._table_fill / self.capacity)
         return host_pos
@@ -1726,6 +1881,7 @@ class TpuAggregator:
             self.metrics["known"] += 1
             return False, False, eh, fields.serial
         bucket.add(fields.serial)
+        self._ckpt_record_host(issuer_idx, eh, fields.serial)
         self._capture_serial(issuer_idx, eh, fields.serial)
         self.metrics["inserted"] += 1
         if issuer_idx >= self.issuer_totals.shape[0]:
@@ -1807,47 +1963,350 @@ class TpuAggregator:
 
     # -- checkpoint ------------------------------------------------------
     def save_checkpoint(self, path: str) -> None:
-        """Device aggregates + registry + host lane to one .npz.
+        """Durable aggregate state at ``path``.
 
         The log cursor itself is checkpointed separately (same contract
         as the reference, /root/reference/storage/types.go:25-42); this
         file makes device state restorable after preemption.
 
-        Written via temp-file + ``os.replace`` so a crash mid-write
-        never corrupts the previous good snapshot (the cursor may point
-        past entries recorded only here, so losing it would drop
-        aggregates permanently), and through an open file object so the
-        snapshot lands at *exactly* the configured path — numpy would
-        otherwise silently append ``.npz``, breaking the resume and
-        --backend=tpu lookups that check the bare path.
+        Two modes (``checkpointMode`` knob, agg/ckpt.py):
+
+        - ``ck01``: every save is the full ``.npz`` snapshot — the
+          compatibility path and the restore oracle.
+        - ``ck02`` (default): the first save (and any save after the
+          dirty log was poisoned, or after ``ckptMaxChain`` segments)
+          anchors with a full base; every other epoch tick appends one
+          O(churn) CTMRCK02 delta segment and updates the chain
+          manifest. Restore replays the chain to the exact state a
+          full save would have written.
+
+        Every file lands via temp + fsync + ``os.replace`` so a crash
+        mid-write never corrupts the previous durable tick; segments
+        land before the manifest that names them, so a torn tick is
+        invisible to the loader.
         """
         with self._save_lock:
             self.complete_outstanding()
-            # Sorted like the filter capture below: host_keys/host_vals
-            # land in the .npz in iteration order, and dict insertion
-            # order differs between a fleet merge and a serial run even
-            # when the contents are equal (ctmrlint: determinism).
+            knobs = self._ckpt_resolved()
+            wrote_segment = False
+            compacting = False
+            if (knobs.mode == ckpt.MODE_INCREMENTAL and self._ckpt_track
+                    and not self._ckpt_dirty_lost
+                    and path == self._ckpt_path):
+                if self._ckpt_chain_len >= knobs.max_chain:
+                    compacting = True  # mandatory anchor
+                else:
+                    man = self._ckpt_manifest_for_extend(path, knobs)
+                    if man is not None:
+                        wrote_segment = self._save_segment(path, man)
+            if not wrote_segment:
+                self._save_full(path, knobs, compacting=compacting)
+        # Filter emission runs OUTSIDE the save lock (the checkpoint
+        # bytes above are already durable): a multi-second scaled
+        # build must not block the fleet-cadence save fan-out or a
+        # concurrent checkpoint_now. _emit_lock still serializes
+        # overlapping emissions (the build cache is not thread-safe).
+        if self.emit_filter_path:
+            with self._emit_lock:
+                self._emit_filter()
+
+    def _save_full(self, path: str, knobs, compacting: bool = False) -> None:
+        """One full ck01 base snapshot (+ fresh manifest in ck02 mode).
+        Caller holds the save lock."""
+        # Snapshot the host items AND cut the dirty generation under
+        # the fold lock: rows folded after this cut stay in the (new)
+        # log — they may also land in the .npz below, which is safe
+        # because segment replay is insert-if-absent/set-union
+        # idempotent; rows folded before the cut are fully inside the
+        # .npz. Sorted so host_keys/host_vals land in content order,
+        # not fold arrival order (ctmrlint: determinism).
+        with self._fold_lock:
             host_items = sorted(
                 (idx, eh, b";".join(s.hex().encode()
                                     for s in sorted(serials)))
                 for (idx, eh), serials in self.host_serials.items()
             )
-            directory = os.path.dirname(os.path.abspath(path))
-            fd, tmp_path = tempfile.mkstemp(
-                prefix=os.path.basename(path) + ".tmp.", dir=directory
-            )
-            try:
-                with os.fdopen(fd, "wb") as fh:
-                    self._write_npz(fh, host_items)
-                    fh.flush()
-                    os.fsync(fh.fileno())
-                os.replace(tmp_path, path)
-            except BaseException:
-                with contextlib.suppress(OSError):
-                    os.unlink(tmp_path)
-                raise
-            if self.emit_filter_path:
-                self._emit_filter()
+            # Arm tracking at the SAME cut: a fold landing during the
+            # npz write below records into the (fresh) log, so it is
+            # carried by the next segment even when the table readback
+            # also caught it — replay is idempotent, omission is not.
+            self._ckpt_shadow = self._ckpt_take_shadow()
+            self._ckpt_clear_log()
+            self._ckpt_track = knobs.mode == ckpt.MODE_INCREMENTAL
+            self._ckpt_dirty_lost = False
+        directory = os.path.dirname(os.path.abspath(path))
+        fd, tmp_path = tempfile.mkstemp(
+            prefix=os.path.basename(path) + ".tmp.", dir=directory
+        )
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                self._write_npz(fh, host_items)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp_path, path)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp_path)
+            # Rows folded before the cut above exist nowhere durable
+            # now; the next save must anchor, not extend.
+            self._ckpt_mark_dirty_lost("base save failed")
+            raise
+        incr_counter("ckpt", "full_saves")
+        if knobs.mode == ckpt.MODE_INCREMENTAL:
+            ckpt.kill_point("base-post-rename")
+            base_sha = ckpt.file_sha256(path)
+            ckpt.write_manifest(path, {
+                "format": ckpt.FORMAT,
+                "baseSha256": base_sha,
+                "maxChain": knobs.max_chain,
+                "chain": [],
+            })
+            ckpt.cleanup_segments(path)
+            self._ckpt_path = path
+            self._ckpt_base_sha = base_sha
+            self._ckpt_tip_token = base_sha
+            self._ckpt_chain_len = 0
+            set_gauge("ckpt", "chain_length", value=0.0)
+            if compacting:
+                incr_counter("ckpt", "compactions")
+        else:
+            # ck01 compatibility mode: a stale manifest from an earlier
+            # ck02 run must never pair with this fresh base. The
+            # loader's base-hash check already ignores it; the unlink
+            # keeps the directory honest.
+            with contextlib.suppress(OSError):
+                os.unlink(ckpt.manifest_path(path))
+            self._ckpt_track = False
+
+    def _ckpt_manifest_for_extend(self, path: str, knobs):
+        """The on-disk manifest this save may append to, or None when
+        the durable tip is not the one in memory (files moved by
+        another process / a ck01-mode save / a fresh path) — the
+        caller anchors instead."""
+        try:
+            man = ckpt.read_manifest(path)
+        except ckpt.CkptError:
+            return None
+        if man is None:
+            # A plain ck01 base we ourselves loaded or wrote can grow
+            # a chain: synthesize its empty manifest, provided the
+            # bytes on disk really are the base we are tracking.
+            if self._ckpt_chain_len:
+                return None
+            if (not os.path.exists(path)
+                    or ckpt.file_sha256(path) != self._ckpt_base_sha):
+                return None
+            return {"format": ckpt.FORMAT,
+                    "baseSha256": self._ckpt_base_sha,
+                    "maxChain": knobs.max_chain, "chain": []}
+        if man.get("baseSha256") != self._ckpt_base_sha:
+            return None
+        chain = man.get("chain", [])
+        try:
+            disk_tip = (chain[-1].get("targetSha256") if chain
+                        else man.get("baseSha256"))
+        except AttributeError:
+            return None
+        if (disk_tip != self._ckpt_tip_token
+                or len(chain) != self._ckpt_chain_len):
+            return None
+        return man
+
+    def _save_segment(self, path: str, man: dict) -> bool:
+        """Append one CTMRCK02 delta segment for this tick and update
+        the manifest. Returns False when the dirty log fails its
+        self-check (the caller anchors with a full base instead).
+        Caller holds the save lock."""
+        with self._fold_lock:
+            rows = self._ckpt_rows
+            host_adds = self._ckpt_host_adds
+            if len(rows) != self._ckpt_dev_inserted:
+                self._ckpt_mark_dirty_lost(
+                    f"recorded {len(rows)} rows, device inserted "
+                    f"{self._ckpt_dev_inserted}")
+                return False
+            blob = self._ckpt_segment_blob(rows, host_adds)
+            shadow = self._ckpt_take_shadow()
+            self._ckpt_clear_log()
+        if not rows and not host_adds and not self._ckpt_blob_nonempty(blob):
+            # Nothing churned since the last durable tick: the chain
+            # on disk already restores to exactly this state.
+            self._ckpt_shadow = shadow
+            return True
+        seq = self._ckpt_chain_len + 1
+        data, header = ckpt.encode_segment(
+            seq, self._ckpt_tip_token, rows, host_adds, blob)
+        try:
+            ckpt.write_segment(path, seq, data)
+            man = dict(man)
+            man["chain"] = list(man.get("chain", [])) + [{
+                "seq": seq,
+                "file": os.path.basename(ckpt.segment_path(path, seq)),
+                "targetSha256": header["targetSha256"],
+                "payloadSha256": header["payloadSha256"],
+                "bytes": len(data),
+                "rows": len(rows) + len(host_adds),
+            }]
+            ckpt.write_manifest(path, man)
+        except BaseException:
+            # The log was already cut; its rows exist nowhere durable
+            # if this tick didn't land. Anchor next time.
+            self._ckpt_mark_dirty_lost("segment write failed")
+            raise
+        self._ckpt_tip_token = header["targetSha256"]
+        self._ckpt_chain_len = seq
+        self._ckpt_shadow = shadow
+        incr_counter("ckpt", "segments_written")
+        incr_counter("ckpt", "segment_bytes", value=float(len(data)))
+        incr_counter("ckpt", "dirty_rows",
+                     value=float(len(rows) + len(host_adds)))
+        set_gauge("ckpt", "chain_length", value=float(seq))
+        return True
+
+    def _ckpt_segment_blob(self, rows, host_adds) -> dict:
+        """The non-row diffs of this tick against the last durable
+        shadow. Caller holds the fold lock — countAfter must be cut
+        at the same instant as the dirty log."""
+        sh = self._ckpt_shadow or {
+            "registry_len": 0,
+            "issuer_totals": np.zeros((0,), np.int64),
+            "verify_verified": np.zeros((0,), np.int64),
+            "verify_failed": np.zeros((0,), np.int64),
+            "crl": {}, "dn": {},
+        }
+
+        def vec_diff(cur, old):
+            padded = np.zeros((cur.shape[0],), np.int64)
+            padded[: old.shape[0]] = old
+            nz = np.nonzero(cur != padded)[0]
+            return {"len": int(cur.shape[0]),
+                    "set": [[int(i), int(cur[i])] for i in nz]}
+
+        def set_adds(cur, old):
+            out = []
+            for i, s in sorted(cur.items()):
+                fresh = s - old.get(i, set())
+                if fresh:
+                    out.append([int(i), sorted(fresh)])
+            return out
+
+        blob = {
+            "baseHour": int(self.base_hour),
+            "countAfter": int(self._table_fill),
+            "registryAdds": self.registry.ids_from(sh["registry_len"]),
+            "issuerTotals": vec_diff(self.issuer_totals,
+                                     sh["issuer_totals"]),
+            "verifyVerified": vec_diff(self.verify_verified,
+                                       sh["verify_verified"]),
+            "verifyFailed": vec_diff(self.verify_failed,
+                                     sh["verify_failed"]),
+            "crlAdds": set_adds(self.crl_sets, sh["crl"]),
+            "dnAdds": set_adds(self.dn_sets, sh["dn"]),
+        }
+        tokens = self.capture_content_hashes()
+        if tokens is not None:
+            dirty = sorted({(int(i), int(e)) for i, e, _ in rows}
+                           | {(int(i), int(e)) for i, e, _ in host_adds})
+            # Round-20 content tokens for the groups this tick dirtied:
+            # a restored run resumes dirty-group filter rebuild (and
+            # the replay self-check) from these.
+            blob["captureTokens"] = [
+                [i, e, format(tokens.get((i, e), 0), "032x")]
+                for i, e in dirty]
+        return blob
+
+    @staticmethod
+    def _ckpt_blob_nonempty(blob: dict) -> bool:
+        return bool(blob["registryAdds"] or blob["issuerTotals"]["set"]
+                    or blob["verifyVerified"]["set"]
+                    or blob["verifyFailed"]["set"]
+                    or blob["crlAdds"] or blob["dnAdds"])
+
+    def _chain_insert(self, keys: np.ndarray, meta: np.ndarray) -> int:
+        """Insert chain-replayed rows into the CURRENT table. The
+        device insert kernels are insert-if-absent with accumulating
+        counts, so replay is idempotent against rows the base already
+        holds (a fold racing a base save may land in both)."""
+        return self._bulk_reinsert(keys, meta)
+
+    def _ckpt_replay_segment(self, header, dev_rows, host_rows,
+                             blob) -> None:
+        """Apply one decoded delta segment on top of the current
+        state (base or earlier segments)."""
+        base_hour = int(blob.get("baseHour", self.base_hour))
+        if base_hour != self.base_hour:
+            raise ckpt.CkptError(
+                f"segment baseHour {base_hour} != base {self.base_hour}")
+        # Registry first: replayed rows may reference issuers the base
+        # predates.
+        for iid in blob.get("registryAdds", []):
+            self.registry.assign_issuer(Issuer.from_string(iid))
+        if dev_rows:
+            n = len(dev_rows)
+            idx = np.array([r[0] for r in dev_rows], np.int64)
+            eh = np.array([r[1] for r in dev_rows], np.int64)
+            slen = np.array([len(r[2]) for r in dev_rows], np.int32)
+            sarr = np.zeros((n, packing.MAX_SERIAL_BYTES), np.uint8)
+            for i, (_, _, sb) in enumerate(dev_rows):
+                sarr[i, : len(sb)] = np.frombuffer(sb, np.uint8)
+            keys = packing.fingerprints_np(idx, eh, sarr, slen)
+            off = eh - self.base_hour
+            if (off < 0).any() or (off >= packing.META_HOUR_SPAN).any():
+                raise ckpt.CkptError("segment exp hour outside meta span")
+            meta = ((idx << packing.META_HOUR_BITS) | off).astype(np.uint32)
+            overflow = self._chain_insert(keys, meta)
+            if overflow:
+                raise ckpt.CkptError(
+                    f"segment replay overflowed {overflow} rows "
+                    f"(base capacity {self.capacity})")
+            self._device_written = True
+        for i_, e_, sb in dev_rows:
+            self._capture_serial(int(i_), int(e_), sb)
+        for i_, e_, sb in host_rows:
+            key = (int(i_), int(e_))
+            self.host_serials.setdefault(key, set()).add(sb)
+            self._capture_serial(key[0], key[1], sb)
+        for name, field in (("issuerTotals", "issuer_totals"),
+                            ("verifyVerified", "verify_verified"),
+                            ("verifyFailed", "verify_failed")):
+            self._ckpt_apply_vec(field, blob.get(name))
+        for i, urls in blob.get("crlAdds", []):
+            self.crl_sets.setdefault(int(i), set()).update(urls)
+        for i, names in blob.get("dnAdds", []):
+            self.dn_sets.setdefault(int(i), set()).update(names)
+        # Self-checks: the replayed table must hold exactly the row
+        # count the writer saw at this tick, and the capture groups
+        # must hash to the writer's round-20 content tokens.
+        self._table_fill = self._table_fill_exact()
+        want = blob.get("countAfter")
+        if want is not None and int(want) != self._table_fill:
+            raise ckpt.CkptError(
+                f"segment replay count {self._table_fill} != "
+                f"recorded {want}")
+        tokens = self.capture_content_hashes()
+        if tokens is not None:
+            for i, e, hx in blob.get("captureTokens", []):
+                got = format(tokens.get((int(i), int(e)), 0), "032x")
+                if got != hx:
+                    raise ckpt.CkptError(
+                        f"capture content token mismatch for group "
+                        f"({i}, {e}) after replay")
+
+    def _ckpt_apply_vec(self, field: str, spec) -> None:
+        """Apply one {len, set: [[idx, value], ...]} vector diff —
+        absolute values at changed indices, so replay in chain order
+        converges regardless of how many segments touch an index."""
+        if not spec:
+            return
+        vec = getattr(self, field)
+        m = int(spec.get("len", vec.shape[0]))
+        if m > vec.shape[0]:
+            grown = np.zeros((m,), np.int64)
+            grown[: vec.shape[0]] = vec
+            vec = grown
+        for i, v in spec.get("set", []):
+            vec[int(i)] = int(v)
+        setattr(self, field, vec)
 
     def _emit_filter(self) -> None:
         """Checkpoint-time filter emission: compile the capture into
@@ -2012,6 +2471,22 @@ class TpuAggregator:
             self.capacity = int(keys.shape[0])
 
     def load_checkpoint(self, path: str) -> None:
+        """Restore from ``path``: the base ``.npz`` plus whatever
+        CTMRCK02 delta chain its manifest names. ``resolve_chain``
+        hash-validates every link before anything is applied, so a
+        torn tick (crash between segment and manifest renames) loads
+        as the previous durable state, never a partial one."""
+        chain = ckpt.resolve_chain(path)
+        self._load_base(path)
+        for header, dev_rows, host_rows, blob in chain.segments:
+            self._ckpt_replay_segment(header, dev_rows, host_rows, blob)
+        if chain.segments:
+            incr_counter("ckpt", "restore_segments",
+                         value=float(len(chain.segments)))
+        self._ckpt_arm(path, chain.base_sha, chain.tip_token,
+                       len(chain.segments))
+
+    def _load_base(self, path: str) -> None:
         z = np.load(path, allow_pickle=True)
         # Checkpoint format stays (keys, meta, count) for cross-version
         # stability; `layout` (absent in pre-round-4 snapshots ⇒ open)
@@ -2126,6 +2601,31 @@ class HostSnapshotAggregator(TpuAggregator):
             rows, keys, meta, max_probes=self.max_probes)
         self.table = buckettable.BucketTable(
             rows=rows, count=np.int32(len(keys) - ovf))
+        return ovf
+
+    def _chain_insert(self, keys: np.ndarray, meta: np.ndarray) -> int:
+        """Chain replay on a host-resident snapshot. bulk_insert_np is
+        blind placement (its contract requires keys NOT already in the
+        table), but a fold racing a base save can land a row in both
+        the base and the following segment — so pre-filter to the
+        genuinely-absent keys and accumulate the count instead of
+        resetting it like _bulk_reinsert does."""
+        if not isinstance(self.table, buckettable.BucketTable):
+            raise RuntimeError(
+                "host-only chain replay needs the bucket layout; "
+                "restore through TpuAggregator/ShardedAggregator")
+        rows = np.asarray(self.table.rows)
+        _, first = np.unique(keys, axis=0, return_index=True)
+        uniq = np.zeros((keys.shape[0],), bool)
+        uniq[first] = True
+        fresh = uniq & ~buckettable.contains_np(
+            rows, keys, max_probes=self.max_probes)
+        ovf = buckettable.bulk_insert_np(
+            rows, keys[fresh], meta[fresh], max_probes=self.max_probes)
+        self.table = buckettable.BucketTable(
+            rows=rows,
+            count=np.int32(int(np.asarray(self.table.count))
+                           + int(fresh.sum()) - ovf))
         return ovf
 
     # _drain_table is inherited: both layouts' drain_np helpers are

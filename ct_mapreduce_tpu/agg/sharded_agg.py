@@ -339,7 +339,7 @@ class ShardedAggregator(TpuAggregator):
         return self.dedup.n_shards
 
     # -- checkpoint ------------------------------------------------------
-    def save_checkpoint(self, path: str) -> None:
+    def _save_full(self, path: str, knobs, compacting: bool = False) -> None:
         import jax.numpy as jnp
 
         from ct_mapreduce_tpu.ops import buckettable, hashtable
@@ -347,7 +347,9 @@ class ShardedAggregator(TpuAggregator):
         # Gather the sharded table to host once, reuse the parent
         # format (the state type must match the dedup's layout so the
         # codec writes the right positional keys/meta + layout +
-        # n_shards fields).
+        # n_shards fields). Only full (ck01 / CTMRCK02 base) saves
+        # gather — a delta segment's rows come from the fold-time
+        # dirty log, which is the whole point of the format.
         state_cls = (buckettable.BucketTable
                      if self.dedup.layout == "bucket"
                      else hashtable.TableState)
@@ -356,7 +358,7 @@ class ShardedAggregator(TpuAggregator):
             count=jnp.asarray(np.asarray(self.dedup.count)),
         )
         try:
-            super().save_checkpoint(path)
+            super()._save_full(path, knobs, compacting=compacting)
         finally:
             self.table = None
 

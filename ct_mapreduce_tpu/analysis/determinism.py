@@ -51,6 +51,10 @@ SCOPE_MODULES: tuple[str, ...] = (
     # rebuilt vs reused verbatim; a hash-order walk here would make
     # "identical corpus" produce different artifact bytes per process.
     "ct_mapreduce_tpu/filter/cache.py",
+    # Round 22 — CTMRCK02 segment/manifest bytes are content-hashed
+    # into a chain (targetSha256 per link); a nondeterministic byte
+    # breaks tip continuation across a restart.
+    "ct_mapreduce_tpu/agg/ckpt.py",
 )
 
 # (module pattern, function name): serialization paths inside
@@ -58,6 +62,9 @@ SCOPE_MODULES: tuple[str, ...] = (
 SCOPE_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("ct_mapreduce_tpu/agg/aggregator.py", "save_checkpoint"),
     ("ct_mapreduce_tpu/agg/aggregator.py", "_write_npz"),
+    ("ct_mapreduce_tpu/agg/aggregator.py", "_save_full"),
+    ("ct_mapreduce_tpu/agg/aggregator.py", "_save_segment"),
+    ("ct_mapreduce_tpu/agg/aggregator.py", "_ckpt_segment_blob"),
 )
 
 _WALL_CLOCK = {

@@ -80,6 +80,11 @@ LOCKS: tuple[LockDecl, ...] = (
     LockDecl("agg.save", "ct_mapreduce_tpu/agg/aggregator.py",
              "TpuAggregator", "_save_lock", 24,
              "whole-checkpoint writes (fleet cadence vs run's own save)"),
+    LockDecl("agg.emit", "ct_mapreduce_tpu/agg/aggregator.py",
+             "TpuAggregator", "_emit_lock", 26,
+             "filter emission after a save (outside agg.save since "
+             "round 22 — a multi-second build must not block the "
+             "fleet save fan-out); acquires agg.fold inside"),
     LockDecl("agg.pending", "ct_mapreduce_tpu/agg/aggregator.py",
              "PendingIngest", "_lock", 30,
              "claim-before-fold; acquires agg.fold inside"),

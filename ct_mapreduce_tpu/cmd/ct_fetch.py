@@ -273,6 +273,15 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         emit_filter = False
 
+    # Checkpoint plane (round 22): pin the CTMRCK02 knobs from the
+    # directives; unset ones resolve through CTMR_* env and the
+    # platform profile inside the aggregator.
+    if model is not None:
+        model.aggregator.configure_checkpointing(
+            mode=config.checkpoint_mode,
+            max_chain=config.ckpt_max_chain,
+            segment_budget_mb=config.ckpt_segment_budget_mb)
+
     # Leader-side incremental build cache: across epoch ticks only
     # churned groups of the merged fleet filter rebuild (tokens always
     # recompute from the merged union sets — never worker hashes).

@@ -128,6 +128,12 @@ class CTConfig:
     # worker (0 = CTMR_DISTRIB_HISTORY env, then 8)
     max_delta_chain: int = 0  # delta links before a mandatory full-
     # snapshot anchor (0 = CTMR_MAX_DELTA_CHAIN env, then 4)
+    checkpoint_mode: str = ""  # "ck01" full-only | "ck02" incremental
+    # ("" = CTMR_CHECKPOINT_MODE env, then ck02 — round 22)
+    ckpt_max_chain: int = 0  # CTMRCK02 delta segments before a
+    # mandatory base anchor (0 = CTMR_CKPT_MAX_CHAIN env, then 8)
+    ckpt_segment_budget_mb: int = 0  # dirty-log cap per tick; beyond
+    # it the save anchors (0 = CTMR_CKPT_SEGMENT_BUDGET_MB, then 256)
     verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
@@ -191,6 +197,9 @@ class CTConfig:
         "platformProfile": ("platform_profile", str),
         "distribHistory": ("distrib_history", int),
         "maxDeltaChain": ("max_delta_chain", int),
+        "checkpointMode": ("checkpoint_mode", str),
+        "ckptMaxChain": ("ckpt_max_chain", int),
+        "ckptSegmentBudgetMB": ("ckpt_segment_budget_mb", int),
     }
 
     @classmethod
@@ -435,6 +444,17 @@ class CTConfig:
             "snapshot anchors in the filter-distribution chain "
             "(CTMR_MAX_DELTA_CHAIN equivalent; default 4 — bounds a "
             "client's worst-case replay work)",
+            "checkpointMode = aggregate-state checkpoint format: ck02 "
+            "(default) appends O(churn) CTMRCK02 delta segments per "
+            "epoch tick between full base anchors; ck01 writes the "
+            "full .npz every tick (compatibility path and restore "
+            "oracle) (CTMR_CHECKPOINT_MODE equivalent)",
+            "ckptMaxChain = CTMRCK02 delta segments between mandatory "
+            "base anchors (CTMR_CKPT_MAX_CHAIN equivalent; default 8 "
+            "— bounds restore replay work)",
+            "ckptSegmentBudgetMB = per-tick dirty-log budget; a tick "
+            "whose churn exceeds it anchors with a full base instead "
+            "(CTMR_CKPT_SEGMENT_BUDGET_MB equivalent; default 256)",
             "",
             "Diagnostics (env only):",
             "CTMR_LOCK_WITNESS=1 wraps every lock the package creates "

@@ -288,3 +288,75 @@ def publish(report: DivergenceReport) -> None:
     if report.sidecar_undecidable >= 0:
         incr_counter("parse", "divergence_sidecar_undecidable",
                      value=float(report.sidecar_undecidable))
+
+
+# -- trend persistence (ROADMAP 5(a)) ------------------------------------
+
+TREND_FORMAT = "CTMRDV01"
+
+
+def record_trend(report: DivergenceReport, path: str) -> dict:
+    """Append one classified run's bucket counts to the JSON trend
+    file at ``path`` (created if missing) and return the updated
+    document. The first recorded run pins ``floorDeviceAcceptRate``;
+    later runs only append — the floor is a ratchet an operator (or a
+    deliberate re-baseline) moves, never a harness run. Written
+    tmp+replace like every durable artifact in the tree."""
+    import json as _json
+    import os as _os
+    import tempfile as _tempfile
+
+    doc: dict = {"format": TREND_FORMAT,
+                 "floorDeviceAcceptRate": None, "runs": []}
+    if _os.path.exists(path):
+        with open(path, encoding="utf-8") as fh:
+            doc = _json.load(fh)
+        if doc.get("format") != TREND_FORMAT:
+            raise ValueError(f"unknown trend format in {path}: "
+                             f"{doc.get('format')!r}")
+    entry = {
+        "run": len(doc["runs"]) + 1,
+        "total": report.total,
+        "deviceAccepts": report.device_accepts,
+        "hostAccepts": report.host_accepts,
+        "bothAccept": report.both_accept,
+        "deviceAcceptHostReject": report.device_accept_host_reject,
+        "hostAcceptDeviceReject": report.host_accept_device_reject,
+        "verdictMismatch": report.verdict_mismatch,
+        "sidecarUndecidable": report.sidecar_undecidable,
+        "deviceAcceptRate": round(report.device_accept_rate, 6),
+    }
+    doc["runs"].append(entry)
+    if doc.get("floorDeviceAcceptRate") is None:
+        doc["floorDeviceAcceptRate"] = entry["deviceAcceptRate"]
+    fd, tmp = _tempfile.mkstemp(
+        prefix=_os.path.basename(path) + ".tmp.",
+        dir=_os.path.dirname(_os.path.abspath(path)))
+    try:
+        with _os.fdopen(fd, "w", encoding="utf-8") as fh:
+            _json.dump(doc, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        _os.replace(tmp, path)
+    except BaseException:
+        import contextlib as _contextlib
+        with _contextlib.suppress(OSError):
+            _os.unlink(tmp)
+        raise
+    return doc
+
+
+def trend_floor(path: str):
+    """The recorded ``parse.device_accept_rate`` floor at ``path``,
+    or None when no trend has been recorded yet. The tier-1 gate
+    asserts a fresh harness run never drops below this."""
+    import json as _json
+    import os as _os
+
+    if not _os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        doc = _json.load(fh)
+    if doc.get("format") != TREND_FORMAT:
+        raise ValueError(f"unknown trend format in {path}: "
+                         f"{doc.get('format')!r}")
+    return doc.get("floorDeviceAcceptRate")

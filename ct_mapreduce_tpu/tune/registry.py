@@ -35,6 +35,7 @@ SECTIONS = {
     "fleet": ("ct_mapreduce_tpu.ingest.fleet", "_FLEET_KNOBS"),
     "filter": ("ct_mapreduce_tpu.filter", "_FILTER_KNOBS"),
     "distrib": ("ct_mapreduce_tpu.distrib", "_DISTRIB_KNOBS"),
+    "ckpt": ("ct_mapreduce_tpu.agg.ckpt", "_CKPT_KNOBS"),
 }
 
 # Declared ladders, coarse-to-fine in the order the search walks them.
@@ -61,6 +62,7 @@ SWEEPABLE = {
         "filterCaptureSpillMB": [64, 256, 1024],
     },
     "distrib": {},
+    "ckpt": {},
 }
 
 # Knobs the search must not touch, each with its justification.
@@ -105,6 +107,15 @@ EXCLUDED = {
                           "policy, not a measured rate",
         "maxDeltaChain": "anchor cadence trades client wire bytes vs "
                          "server storage — policy, not platform",
+    },
+    "ckpt": {
+        "checkpointMode": "wire-format semantic choice (ck01 oracle "
+                          "vs ck02 incremental), not a swept scalar",
+        "ckptMaxChain": "anchor cadence trades restore replay work "
+                        "vs per-tick bytes — durability policy",
+        "ckptSegmentBudgetMB": "dirty-log memory ceiling is an "
+                               "operator host-RAM policy, not a "
+                               "measured performance rate",
     },
 }
 

@@ -234,6 +234,33 @@ def test_bench_smoke_distrib_gate():
     assert 0 < out["smoke_distrib_p50_ms"] <= out["smoke_distrib_p99_ms"]
 
 
+@pytest.mark.timeout(180)
+def test_bench_smoke_ckpt_gate():
+    """Incremental checkpoint leg (ISSUE 18): run_ckpt_smoke itself
+    gates a 1%-churn CTMRCK02 delta tick >= 5x faster than a full ck01
+    save, digest parity between the chain restore, the writer, and the
+    ck01 oracle restore, and a bounded chain (anchor observed at
+    ckptMaxChain); here we pin that the leg ran with real work and the
+    BENCHLOG numbers were recorded."""
+    import jax
+
+    if os.environ.get("CT_TPU_TESTS", "") == "":
+        jax.config.update("jax_platforms", "cpu")
+    import bench
+
+    # 50K entries is the smallest scale the gate accepts; the tier-1
+    # wall rides the capped-run dot budget, so don't pay for more here
+    # (the 10^7 headline lives in stagecost/BENCHLOG).
+    os.environ.setdefault("CT_BENCH_SMOKE_CKPT_ENTRIES", "50000")
+    out = bench.run_ckpt_smoke()  # raises BenchError on any miss
+    assert out["metric"] == "ct_ckpt_smoke"
+    assert out["value"] >= 5.0
+    assert out["smoke_ckpt_entries"] >= 50_000
+    assert out["smoke_ckpt_tick_ms"] < out["smoke_ckpt_full_ms"]
+    assert out["smoke_ckpt_parity"] == 1
+    assert out["smoke_ckpt_chain_bounded"] == 1
+
+
 @pytest.mark.timeout(240)
 def test_bench_smoke_verify_gate():
     """Verify leg (ISSUE 8): run_verify_smoke itself gates verdict

@@ -409,6 +409,61 @@ def test_mutation_fuzz_walker_host_agreement():
     assert snap["counters"]["parse.divergence_verdict_mismatch"] == 0.0
 
 
+def test_divergence_trend_floor_gate():
+    """ROADMAP 5(a) increment (round 22): the divergence harness's
+    bucket counts persist to a trend file (DIVERGENCE_TREND.json,
+    core/divergence.record_trend), and `parse.device_accept_rate`
+    must never silently drop below the recorded floor — a walker
+    change that rejects lanes it used to accept shows up here before
+    it shows up as fleet-wide host-lane throughput loss."""
+    import json
+    import os
+
+    from ct_mapreduce_tpu.core import divergence
+
+    trend_path = os.path.join(os.path.dirname(__file__), "..",
+                              "DIVERGENCE_TREND.json")
+    floor = divergence.trend_floor(trend_path)
+    assert floor is not None and 0 < floor <= 1, floor
+
+    rng = np.random.default_rng(20260730)
+    bases = fixture_certs()
+    mutants = []
+    for _ in range(300):
+        bi = int(rng.integers(len(bases)))
+        base = bytearray(bases[bi])
+        pos = int(rng.integers(len(base)))
+        base[pos] ^= int(rng.integers(1, 256))
+        mutants.append(bytes(base))
+    report = divergence.classify_corpus(mutants)
+    assert report.device_accept_rate >= floor, (
+        f"device_accept_rate {report.device_accept_rate:.4f} dropped "
+        f"below the recorded floor {floor} (DIVERGENCE_TREND.json); "
+        "a deliberate strictness change must re-baseline the floor "
+        "explicitly, with the why in the commit")
+
+    # record_trend round-trips: append to a copy, floor is a ratchet
+    # the harness itself never moves.
+    with open(trend_path, encoding="utf-8") as fh:
+        before = json.load(fh)
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        tmp = os.path.join(td, "trend.json")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(before, fh)
+        doc = divergence.record_trend(report, tmp)
+        assert doc["floorDeviceAcceptRate"] == before["floorDeviceAcceptRate"]
+        assert len(doc["runs"]) == len(before["runs"]) + 1
+        assert doc["runs"][-1]["run"] == len(doc["runs"])
+        assert (doc["runs"][-1]["deviceAcceptRate"]
+                == round(report.device_accept_rate, 6))
+        # Fresh-file path: the first run pins the floor.
+        fresh = os.path.join(td, "fresh.json")
+        doc2 = divergence.record_trend(report, fresh)
+        assert (doc2["floorDeviceAcceptRate"]
+                == round(report.device_accept_rate, 6))
+
+
 def test_grammar_mutation_fuzz_buckets():
     """ROADMAP 5(a) increment: the grammar-aware mutators (length-
     field surgery, nested-TLV truncation/extension per ParsEval's

@@ -312,6 +312,99 @@ def test_fleet_kill_and_resume(tmp_path, compile_cache):
     assert merged["total"] > 0
 
 
+@pytest.mark.parametrize("scenario,kill_env", [
+    # Die right after the delta segment's rename, BEFORE the manifest
+    # update: the durable chain is still the pre-tick one; the stray
+    # renamed segment is unlisted and must be ignored (and later
+    # overwritten) by the resumed worker.
+    ("mid-segment", {"CTMR_CKPT_KILL": "seg-post-rename"}),
+    # Die inside a COMPACTION, after the fresh anchor base's rename
+    # but before its fresh manifest: the old manifest's baseSha256 no
+    # longer matches the on-disk base, so the loader must heal to
+    # base-alone (the anchor IS the full state at its cut).
+    ("mid-compaction", {"CTMR_CKPT_KILL": "base-post-rename:2",
+                        "CTMR_CKPT_MAX_CHAIN": "1"}),
+])
+@pytest.mark.timeout(340)
+def test_fleet_kill_points_ck02(tmp_path, compile_cache, scenario,
+                                kill_env):
+    """ISSUE 18 acceptance: a worker SIGKILLed at the exact CTMRCK02
+    write boundaries (mid-delta-segment, mid-compaction) leaves a
+    chain that VALIDATES and restores to the last durable tick, and a
+    restarted worker resumes through it to the uninterrupted run's
+    aggregate. The self-kill rides ckpt.kill_point (CTMR_CKPT_KILL),
+    so death lands deterministically at the boundary under test —
+    victim cache policy as in the round-14 test (read-only consume)."""
+    from tools import fleet as harness
+
+    from ct_mapreduce_tpu.agg import ckpt
+    from ct_mapreduce_tpu.ingest import ctclient
+    from ct_mapreduce_tpu.utils.miniredis import MiniRedis
+
+    fixture_path = str(tmp_path / "fixture.json")
+    fixture = harness.build_fixture(
+        fixture_path, n_logs=1, entries_per_log=192, dupes=16,
+        max_batch=32)
+    url = next(iter(fixture["logs"]))
+    wdir = str(tmp_path / "w0")
+    npz = os.path.join(wdir, "agg.npz")
+
+    server = MiniRedis().start()
+    try:
+        # spawn_worker forwards os.environ, so the kill spec (and for
+        # the compaction case a maxChain=1 override that forces an
+        # anchor on the 2nd tick) reaches only the victim child.
+        for k, v in kill_env.items():
+            os.environ[k] = v
+        try:
+            victim = harness.spawn_worker(
+                0, 1, fixture_path, wdir, server.address,
+                checkpoint_period="300ms", throttle_ms=150,
+                coordinator="redis", compile_cache_readonly=True)
+            out = victim.communicate(timeout=300)[0]
+        finally:
+            for k in kill_env:
+                os.environ.pop(k, None)
+        assert victim.returncode == -signal.SIGKILL, (
+            f"{scenario}: victim did not die at the kill point "
+            f"(rc={victim.returncode}):\n{out[-4000:]}")
+
+        # Durable contract at the moment of death: the on-disk chain
+        # validates and loads — death between the segment/base rename
+        # and the manifest update never publishes a torn state.
+        chain = ckpt.resolve_chain(npz)
+        assert len(chain.segments) == 0, scenario
+        if scenario == "mid-segment":
+            # The renamed-but-unlisted segment really is on disk.
+            assert os.path.exists(ckpt.segment_path(npz, 1)), scenario
+        victim_snap = harness.merged_snapshot([npz])
+        assert victim_snap["total"] > 0
+
+        # Resume in-process (round-14 discipline) with the kill spec
+        # cleared: the worker must extend/anchor past the stray
+        # artifacts and finish with the uninterrupted run's aggregate.
+        from ct_mapreduce_tpu.cmd import ct_fetch
+
+        transport = harness.FixtureTransport(fixture)
+        orig_transport = ctclient._urllib_transport
+        ctclient._urllib_transport = transport
+        try:
+            ini = os.path.join(wdir, "resume.ini")
+            harness.write_worker_ini(
+                ini, fixture, npz, redis_addr=server.address,
+                checkpoint_period="300ms", coordinator="redis")
+            rc = ct_fetch.main(["-config", ini, "-nobars"])
+        finally:
+            ctclient._urllib_transport = orig_transport
+    finally:
+        server.stop()
+    assert rc == 0
+    merged = harness.merged_snapshot([npz])
+    ref = harness.run_serial_reference(fixture, str(tmp_path))
+    assert merged == ref
+    assert merged["total"] > 0
+
+
 # -- global-mesh collectives (capability-gated) -------------------------
 
 

@@ -35,6 +35,12 @@ device-value read. Stage deltas then give real per-stage costs:
             The wall delta across K is the per-dispatch toll that
             staging amortizes (CT_SC_DISPATCH_B overrides the chunk
             lane count).
+  ckpt    — checkpoint-plane walls (round 22): full ck01 save vs
+            incremental CTMRCK02 tick at churn {0.1%, 1%, 10%} of the
+            fixture, restore wall at several chain depths; restored-
+            state parity (tune.harness.ckpt_state_digest) asserted at
+            every point against a ck01 oracle (CT_SC_CKPT_ENTRIES /
+            _BITS / _CHURN / _DEPTHS override the fixture and sweeps).
   verify  — the batched ECDSA-P256 verification kernel
             (ops/ecdsa.verify_p256) at B ∈ {256, 1024, 4096}:
             ns/signature per batch width on a mixed valid/invalid
@@ -444,12 +450,159 @@ def main() -> None:
         if p384_b:
             sweep(ecdsa.P384_OPS, p384_b, p384_w, n_uniq=16, n_keys=3)
 
+    def run_ckpt():
+        """Checkpoint-plane cost (CTMRCK02, round 22): full ck01 save
+        wall vs incremental ck02 tick wall at churn ∈ {0.1%, 1%, 10%}
+        of the fixture, plus restore wall at several chain depths —
+        restored-state parity (tune.harness.ckpt_state_digest)
+        asserted at every point, against both the live writer and a
+        ck01 oracle save of the same state.
+
+        The fixture pre-fills via the bulk path (setup, untimed);
+        churn folds through the pre-parsed lane so the per-tick dirty
+        log records it exactly as production folds would.
+
+        Env: CT_SC_CKPT_ENTRIES (default 10**7), CT_SC_CKPT_BITS
+        (table log2 capacity, default 25), CT_SC_CKPT_CHURN (default
+        0.001,0.01,0.1), CT_SC_CKPT_DEPTHS (restore chain depths,
+        default 1,4,8). CT_SC_CKPT_STATE names a reusable fixture
+        checkpoint: the 10^7 pre-fill (host-side SHA-256 of every
+        serial) dwarfs the measured section, so build it once, save
+        it as a plain ck01 snapshot, and let later invocations
+        restore instead of rebuild (same-topology restores load rows
+        directly — no rehash)."""
+        import shutil
+        import tempfile
+
+        from ct_mapreduce_tpu.agg.aggregator import TpuAggregator
+        from ct_mapreduce_tpu.tune import harness
+
+        entries = int(os.environ.get("CT_SC_CKPT_ENTRIES", str(10**7)))
+        bits = int(os.environ.get("CT_SC_CKPT_BITS", "25"))
+        churns = [float(c) for c in os.environ.get(
+            "CT_SC_CKPT_CHURN", "0.001,0.01,0.1").split(",") if c]
+        depths = [int(x) for x in os.environ.get(
+            "CT_SC_CKPT_DEPTHS", "1,4,8").split(",") if x]
+
+        t0 = time.perf_counter()
+        state = os.environ.get("CT_SC_CKPT_STATE", "")
+        if state and os.path.exists(state):
+            say(f"ckpt: restoring {entries:,}-entry fixture from {state}")
+            agg = TpuAggregator(capacity=1 << bits, batch_size=4096,
+                                grow_at=0.0)
+            agg.load_checkpoint(state)
+            eh = agg.base_hour + 1000
+            if int(agg._table_fill) != entries:
+                raise SystemExit(
+                    f"fixture state {state} holds {int(agg._table_fill):,}"
+                    f" entries, wanted {entries:,}: rebuild it")
+            say(f"ckpt: fixture restored in {time.perf_counter() - t0:.1f}s")
+        else:
+            say(f"ckpt: building {entries:,}-entry fixture (2^{bits} slots)")
+            agg, eh = harness.build_aggregator(entries, bits)
+            say(f"ckpt: fixture built in {time.perf_counter() - t0:.1f}s")
+            if state:
+                agg.configure_checkpointing(mode="ck01")
+                agg.save_checkpoint(state)
+                say(f"ckpt: fixture cached to {state}")
+        tmp = tempfile.mkdtemp(prefix="stagecost-ckpt.")
+        try:
+            def fresh_reader():
+                return TpuAggregator(capacity=1 << bits,
+                                     batch_size=4096, grow_at=0.0)
+
+            p01 = os.path.join(tmp, "ck01.npz")
+            agg.configure_checkpointing(mode="ck01")
+            t0 = time.perf_counter()
+            agg.save_checkpoint(p01)
+            full_s = time.perf_counter() - t0
+            say(f"ckpt  ck01 full save   {full_s * 1e3:10.1f} ms  "
+                f"({os.path.getsize(p01) / 1e6:.1f} MB)")
+
+            p02 = os.path.join(tmp, "ck02.npz")
+            agg.configure_checkpointing(mode="ck02",
+                                        max_chain=len(churns) + 1)
+            t0 = time.perf_counter()
+            agg.save_checkpoint(p02)
+            say(f"ckpt  ck02 base anchor {(time.perf_counter() - t0) * 1e3:10.1f} ms")
+
+            start = entries
+            speedups = {}
+            for c in churns:
+                nch = max(1, int(entries * c))
+                harness.ckpt_churn(agg, eh, nch, start)
+                start += nch
+                t0 = time.perf_counter()
+                agg.save_checkpoint(p02)
+                seg_s = time.perf_counter() - t0
+                speedups[c] = full_s / seg_s
+                seq = agg._ckpt_chain_len
+                seg_mb = os.path.getsize(
+                    os.path.join(tmp, f"ck02.npz.ckseg-{seq:08d}")) / 1e6
+                say(f"ckpt  ck02 tick churn={c:7.2%} ({nch:>9,} rows) "
+                    f"{seg_s * 1e3:10.1f} ms  ({seg_mb:.1f} MB, "
+                    f"{full_s / seg_s:.1f}x vs full)")
+
+            # Parity at the tip: chain restore == live writer == a
+            # ck01 oracle save of the same state.
+            want = harness.ckpt_state_digest(agg)
+            r = fresh_reader()
+            t0 = time.perf_counter()
+            r.load_checkpoint(p02)
+            say(f"ckpt  ck02 restore (chain {len(churns)})"
+                f" {(time.perf_counter() - t0) * 1e3:10.1f} ms")
+            harness.require(harness.ckpt_state_digest(r) == want,
+                            "ck02 chain restore diverged from writer")
+            p01b = os.path.join(tmp, "oracle.npz")
+            agg.configure_checkpointing(mode="ck01")
+            agg.save_checkpoint(p01b)
+            o = fresh_reader()
+            o.load_checkpoint(p01b)
+            harness.require(harness.ckpt_state_digest(o) == want,
+                            "ck01 oracle restore diverged from writer")
+            say("ckpt  restore parity exact (ck02 chain == ck01 oracle)")
+
+            # Restore wall vs chain depth (1% churn per tick).
+            agg.configure_checkpointing(mode="ck02",
+                                        max_chain=max(depths) + 1)
+            pd = os.path.join(tmp, "depth.npz")
+            agg.save_checkpoint(pd)
+            nch = max(1, int(entries * 0.01))
+            done = 0
+            for d in sorted(depths):
+                while done < d:
+                    harness.ckpt_churn(agg, eh, nch, start)
+                    start += nch
+                    agg.save_checkpoint(pd)
+                    done += 1
+                r = fresh_reader()
+                t0 = time.perf_counter()
+                r.load_checkpoint(pd)
+                w = time.perf_counter() - t0
+                harness.require(
+                    harness.ckpt_state_digest(r)
+                    == harness.ckpt_state_digest(agg),
+                    f"restore parity broke at chain depth {d}")
+                say(f"ckpt  restore depth={d}  {w * 1e3:10.1f} ms "
+                    "(parity exact)")
+
+            one_pct = speedups.get(0.01)
+            if one_pct is not None:
+                say(f"ckpt  headline: 1%-churn tick {one_pct:.1f}x "
+                    "faster than full ck01 save")
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
     stages = [
         ("read", s_read), ("pack", s_pack), ("pack2", s_pack2),
         ("parse", s_parse),
         ("serial", s_serial), ("sha", s_sha), ("lanes", s_lanes),
     ]
     results = {}
+    if not only or "ckpt" in only:
+        run_ckpt()
+    if only == {"ckpt"}:
+        return
     if not only or "decode" in only:
         run_decode()
     if only == {"decode"}:
