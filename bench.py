@@ -2692,6 +2692,387 @@ def run_tune_smoke() -> dict:
     }
 
 
+def run_obs_smoke() -> dict:
+    """CT_BENCH_SMOKE observability leg (round 23): the fleet-wide
+    observability plane driven LIVE over a W=2 worker fleet
+    (tools/fleet.py worker processes, miniredis fabric, runForever):
+
+      (1) cross-process trace correlation: ct-query requests from
+          THIS process mint traceparent headers; the serving worker's
+          spans carry the same trace_id, and fleetobs.merge_traces
+          (the traceview --merge engine) stitches client + both
+          worker trace exports into ONE timeline with per-worker
+          tracks;
+      (2) metrics fan-in parity EXACT: within one /metrics/fleet
+          body every unlabeled fleet-summed counter equals the sum of
+          its {worker=...} lines (fleet_counter_parity), and — once
+          ingest quiesces — the fleet total of the insert counter
+          equals the sum of live per-worker /metrics scrapes;
+      (3) liveness -> health rollup: SIGSTOP'ing worker 1 flips
+          worker 0's /healthz/fleet to 503 within the (shrunk)
+          heartbeat-TTL'd liveness window; SIGCONT recovers it;
+      (4) overhead gated HONESTLY (rounds-11/14 convention): raw
+          walls on this 1-core box carry no timing claim; the gate is
+          the MODELED obs cost — measured per-span emission cost x
+          spans recorded + per-publish payload cost x fan-in
+          publishes — under 2% of the workers' wall.
+    """
+    import json as _json
+    import re as _re
+    import signal as _signal
+    import socket as _socket
+    import tempfile
+    import urllib.error as _urlerr
+    import urllib.request as _urlreq
+
+    if os.environ.get("CT_TPU_TESTS", "") == "":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tools import fleet as harness
+
+    from ct_mapreduce_tpu.ingest.fleet import partition_map
+    from ct_mapreduce_tpu.serve.client import QueryClient
+    from ct_mapreduce_tpu.telemetry import fleetobs, trace
+    from ct_mapreduce_tpu.utils.miniredis import MiniRedis
+
+    state_dir = tempfile.mkdtemp(prefix="ct-obs-smoke-")
+    fixture_path = os.path.join(state_dir, "fixture.json")
+    fixture = harness.build_fixture(
+        fixture_path, n_logs=2, entries_per_log=48, dupes=4, max_batch=32)
+    urls = list(fixture["logs"])
+    owners = partition_map(urls, 2)
+    if sorted(owners) != sorted(urls) or set(owners.values()) != {0, 1}:
+        raise BenchError(f"degenerate W=2 partition: {owners}")
+
+    def free_port() -> int:
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def http_get(url: str, timeout: float = 3.0) -> tuple[int, str]:
+        try:
+            with _urlreq.urlopen(url, timeout=timeout) as resp:
+                return resp.getcode(), resp.read().decode()
+        except _urlerr.HTTPError as err:
+            try:
+                return err.code, err.read().decode()
+            except OSError:
+                return err.code, ""
+        except (OSError, _urlerr.URLError):
+            return -1, ""
+
+    def counter_of(body: str, name: str) -> float:
+        m = _re.search(rf"(?m)^{_re.escape(name)} ([0-9eE.+-]+)$", body)
+        return float(m.group(1)) if m else -1.0
+
+    mports = [free_port(), free_port()]
+    qport = free_port()
+    trace_paths = [os.path.join(state_dir, f"w{w}-trace.json")
+                   for w in range(2)]
+    # Heartbeats fire every 2s (FleetService default); 4s liveness
+    # keeps one full missed beat of slack against 1-core scheduling
+    # jitter while the SIGSTOP flip still lands in seconds.
+    liveness_s = 4.0
+    fleet_url = f"http://127.0.0.1:{mports[0]}/healthz/fleet"
+    insert_key = "ct_fetch_insertCertificate"
+
+    if trace.enabled():  # a prior leg's tracer must not leak in
+        trace.disable()
+
+    redis = MiniRedis().start()
+    procs: list = []
+    try:
+        t0 = time.monotonic()
+        procs = [
+            harness.spawn_worker(
+                w, 2, fixture_path, os.path.join(state_dir, f"obs-w{w}"),
+                redis.address, checkpoint_period="500ms",
+                coordinator="redis", run_forever=True,
+                query_port=(qport if w == 0 else 0),
+                trace_path=trace_paths[w], metrics_port=mports[w],
+                # Generous thresholds: the SLO rule layer runs (slo.*
+                # gauges ride every payload) without breaching.
+                ini_lines=("sloMaxIngestLag = 1000000",
+                           "sloMaxServeP99Ms = 60000"),
+                extra_env={"CTMR_FLEET_LIVENESS_S": str(liveness_s)})
+            for w in range(2)
+        ]
+
+        def alive_or_raise():
+            for w, p in enumerate(procs):
+                if p.poll() is not None:
+                    out = p.communicate()[0]
+                    raise BenchError(
+                        f"obs worker {w} died rc={p.returncode}: "
+                        f"{out[-1500:]}")
+
+        # (a) both per-worker metrics planes answer
+        deadline = time.monotonic() + 300
+        ready = [False, False]
+        while not all(ready):
+            if time.monotonic() > deadline:
+                raise BenchError(f"workers not serving /healthz: {ready}")
+            alive_or_raise()
+            for w in range(2):
+                if not ready[w]:
+                    st, _ = http_get(
+                        f"http://127.0.0.1:{mports[w]}/healthz")
+                    ready[w] = st in (200, 503)
+            time.sleep(0.25)
+
+        # (b) the rollup reports the whole fleet healthy
+        rollup = None
+        while rollup is None:
+            if time.monotonic() > deadline:
+                raise BenchError("fleet rollup never became healthy")
+            alive_or_raise()
+            st, raw = http_get(fleet_url)
+            if st == 200:
+                body = _json.loads(raw)
+                if (body.get("healthy")
+                        and body.get("workers_reporting") == 2):
+                    rollup = body
+            time.sleep(0.25)
+        if rollup["missing"] or rollup["leader_epoch_skew"] > 1:
+            raise BenchError(f"inconsistent healthy rollup: {rollup}")
+        roles = [e["role"] for e in rollup["workers"].values()]
+        if "leader" not in roles:
+            raise BenchError(f"no leader in the rollup: {roles}")
+
+        # (c) ingest quiesces: fleet-summed insert counter == sum of
+        # live per-worker scrapes (cross-scrape parity), and in-body
+        # counter parity is exact on the same scrape.
+        fleet_metrics_url = f"http://127.0.0.1:{mports[0]}/metrics/fleet"
+        cross = None
+        cross_deadline = time.monotonic() + 180
+        while cross is None:
+            if time.monotonic() > cross_deadline:
+                raise BenchError(
+                    "fleet/live insert-counter parity never converged")
+            alive_or_raise()
+            live = [counter_of(
+                http_get(f"http://127.0.0.1:{p}/metrics")[1], insert_key)
+                for p in mports]
+            st, mf_body = http_get(fleet_metrics_url)
+            total = counter_of(mf_body, insert_key)
+            if st == 200 and min(live) > 0 and total == sum(live):
+                cross = {"live": live, "total": total, "body": mf_body}
+            else:
+                time.sleep(0.5)
+        mf_body = cross["body"]
+        bad = fleetobs.fleet_counter_parity(mf_body)
+        if bad:
+            raise BenchError(f"/metrics/fleet counter parity broken: {bad}")
+        for w in range(2):
+            if f'{insert_key}{{worker="{w}"}}' not in mf_body:
+                raise BenchError(f"no worker-{w} series in /metrics/fleet")
+            if f'slo_degraded{{worker="{w}"}}' not in mf_body:
+                raise BenchError(f"worker {w} published no slo.* gauges")
+        n_counters = len(_re.findall(r"(?m)^# TYPE \S+ counter$", mf_body))
+        log(f"obs smoke: fan-in parity exact over {n_counters} counters "
+            f"({insert_key} fleet {cross['total']:.0f} == live "
+            f"{cross['live']})")
+
+        # (d) cross-process trace correlation: ct-query requests from
+        # THIS process against worker 0's query plane.
+        trace.enable(os.path.join(state_dir, "client-trace.json"))
+        qdeadline = time.monotonic() + 60
+        while True:
+            st, _ = http_get(f"http://127.0.0.1:{qport}/healthz")
+            if st == 200:
+                break
+            if time.monotonic() > qdeadline:
+                raise BenchError("query plane never served /healthz")
+            alive_or_raise()
+            time.sleep(0.25)
+        client = QueryClient(f":{qport}", timeout_s=10.0)
+        n_queries = 4
+        for i in range(n_queries):
+            res = client.query_one(
+                "obs-smoke-issuer", "2031-06-15", f"0bad{i:04x}")
+            if "results" not in res:
+                raise BenchError(f"query {i} malformed answer: {res}")
+        client_doc_path = trace.export()
+        trace.disable()
+        with open(client_doc_path) as fh:
+            client_doc = _json.load(fh)
+
+        # fan-in publish counts for the overhead model, scraped live
+        # before the shutdown tears the servers down
+        publishes = sum(
+            max(0.0, counter_of(
+                http_get(f"http://127.0.0.1:{p}/metrics")[1],
+                "fleet_obs_publishes"))
+            for p in mports)
+
+        # (e) SIGSTOP worker 1 -> worker 0's rollup flips 503 within
+        # the liveness TTL; SIGCONT recovers it.
+        os.kill(procs[1].pid, _signal.SIGSTOP)
+        t_stop = time.monotonic()
+        flip_s = None
+        flip_body: dict = {}
+        while time.monotonic() - t_stop < liveness_s * 4:
+            st, raw = http_get(fleet_url)
+            if st == 503:
+                flip_s = time.monotonic() - t_stop
+                flip_body = _json.loads(raw) if raw else {}
+                break
+            time.sleep(0.1)
+        os.kill(procs[1].pid, _signal.SIGCONT)
+        if flip_s is None:
+            raise BenchError(f"SIGSTOP'd worker never degraded the "
+                             f"rollup (TTL {liveness_s}s)")
+        if flip_s > liveness_s + 1.5:
+            raise BenchError(
+                f"rollup flipped in {flip_s:.1f}s — past the "
+                f"{liveness_s}s TTL (+1.5s scrape slack)")
+        reasons = flip_body.get("degraded", [])
+        if not any("worker 1" in r for r in reasons):
+            raise BenchError(f"degradation blames nobody: {reasons}")
+        recovered = None
+        rec_deadline = time.monotonic() + 90
+        while recovered is None:
+            if time.monotonic() > rec_deadline:
+                raise BenchError("rollup never recovered after SIGCONT")
+            st, raw = http_get(fleet_url)
+            if st == 200 and _json.loads(raw).get("healthy"):
+                recovered = time.monotonic() - t_stop
+            time.sleep(0.25)
+        log(f"obs smoke: SIGSTOP->503 in {flip_s:.2f}s "
+            f"(TTL {liveness_s}s), recovered {recovered:.1f}s after")
+
+        # (f) clean shutdown -> each worker exports its trace ring
+        for p in procs:
+            os.kill(p.pid, _signal.SIGTERM)
+        outs = [p.communicate(timeout=180)[0] for p in procs]
+        wall = time.monotonic() - t0
+    finally:
+        if trace.enabled():
+            trace.disable()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.kill(p.pid, _signal.SIGCONT)
+                except OSError:
+                    pass
+                p.kill()
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    pass
+        redis.stop()
+
+    for w, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise BenchError(
+                f"obs worker {w} rc={p.returncode}: {out[-1500:]}")
+    dones = [next(e for e in harness.child_events(out)
+                  if e["event"] == "done") for out in outs]
+    worker_wall = sum(d["wall_s"] for d in dones)
+
+    docs = []
+    for w in range(2):
+        if not os.path.exists(trace_paths[w]):
+            raise BenchError(f"worker {w} exported no trace")
+        with open(trace_paths[w]) as fh:
+            docs.append(_json.load(fh))
+
+    merged = fleetobs.merge_traces([client_doc] + docs)
+    events = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    pids = {e.get("pid") for e in events}
+    if len(pids) < 3:
+        raise BenchError(f"merged timeline spans {len(pids)} pids "
+                         f"(want client + 2 workers)")
+    labels = {e["args"]["name"]
+              for e in merged["traceEvents"]
+              if e.get("ph") == "M" and e.get("name") == "process_name"}
+    for want in ("worker 0 (", "worker 1 ("):
+        if not any(lab.startswith(want) for lab in labels):
+            raise BenchError(f"no '{want}...' track in the merge: "
+                             f"{labels}")
+    my_pid = os.getpid()
+    minted = {e["args"]["trace_id"]
+              for e in client_doc.get("traceEvents", [])
+              if e.get("name") == "query.client"
+              and "trace_id" in e.get("args", {})}
+    if len(minted) != n_queries:
+        raise BenchError(f"client minted {len(minted)} trace ids for "
+                         f"{n_queries} queries")
+    correlated = {
+        tid for tid in minted
+        if any(e.get("args", {}).get("trace_id") == tid
+               and e.get("pid") != my_pid for e in events)}
+    if not correlated:
+        raise BenchError("no client trace_id reached a worker span — "
+                         "the traceparent header did not propagate")
+    log(f"obs smoke: merged timeline over {len(pids)} processes, "
+        f"{len(correlated)}/{n_queries} request trace ids correlated "
+        f"across the process boundary")
+
+    # (g) overhead, modeled (rounds-11/14 honesty convention): the
+    # 1-core walls carry no timing claim; the model multiplies the
+    # MEASURED per-event costs (span emission on a live ring, payload
+    # build over this process's real sink) by the counts this leg
+    # actually recorded.
+    tr = trace.SpanTracer(path=None, ring_size=4096)
+    n_bench = 20000
+    t_b = time.perf_counter()
+    for _ in range(n_bench):
+        with tr.span("serve.wait", "bench"):
+            pass
+    per_span_s = (time.perf_counter() - t_b) / n_bench
+    n_pub = 200
+    t_b = time.perf_counter()
+    for _ in range(n_pub):
+        fleetobs.build_obs_payload(0, 2, fleet_stats={"role": "leader"},
+                                   slo={"values": {}, "degraded": []})
+    per_pub_s = (time.perf_counter() - t_b) / n_pub
+    spans = sum(1 for doc in docs for e in doc.get("traceEvents", [])
+                if e.get("ph") in ("X", "i"))
+    if spans <= 0:
+        raise BenchError("workers recorded no spans")
+    if publishes <= 0:
+        raise BenchError("no fan-in publishes counted")
+    modeled_s = spans * per_span_s + publishes * per_pub_s
+    overhead_pct = 100.0 * modeled_s / max(worker_wall, 1e-9)
+    if overhead_pct >= 2.0:
+        raise BenchError(
+            f"modeled obs overhead {overhead_pct:.3f}% >= 2% "
+            f"({spans} spans x {per_span_s * 1e6:.1f}us + "
+            f"{publishes:.0f} publishes x {per_pub_s * 1e6:.0f}us over "
+            f"{worker_wall:.1f}s)")
+    log(f"obs smoke: modeled overhead {overhead_pct:.3f}% of "
+        f"{worker_wall:.1f}s worker wall ({spans} spans @ "
+        f"{per_span_s * 1e6:.1f}us, {publishes:.0f} publishes @ "
+        f"{per_pub_s * 1e6:.0f}us)")
+
+    return {
+        "metric": "ct_obs_smoke",
+        "value": float(len(events)),
+        "unit": "events",
+        "smoke_obs_workers": 2,
+        "smoke_obs_merged_events": len(events),
+        "smoke_obs_merged_pids": len(pids),
+        "smoke_obs_trace_ids": n_queries,
+        "smoke_obs_correlated": len(correlated),
+        "smoke_obs_parity": 1,
+        "smoke_obs_parity_counters": n_counters,
+        "smoke_obs_cross_scrape_parity": 1,
+        "smoke_obs_insert_total": cross["total"],
+        "smoke_obs_liveness_s": liveness_s,
+        "smoke_obs_flip_s": round(flip_s, 3),
+        "smoke_obs_recover_s": round(recovered, 3),
+        "smoke_obs_spans": spans,
+        "smoke_obs_publishes": publishes,
+        "smoke_obs_per_span_us": round(per_span_s * 1e6, 3),
+        "smoke_obs_per_publish_us": round(per_pub_s * 1e6, 2),
+        "smoke_obs_overhead_pct": round(overhead_pct, 4),
+        "smoke_obs_wall_s": round(wall, 2),
+        "smoke_obs_worker_wall_s": round(worker_wall, 2),
+    }
+
+
 def smoke_main() -> int:
     try:
         payload = run_smoke()

@@ -270,6 +270,7 @@ def default_checkers() -> list[Checker]:
     from ct_mapreduce_tpu.analysis.metric_registry import (
         MetricRegistryChecker,
     )
+    from ct_mapreduce_tpu.analysis.span_registry import SpanRegistryChecker
 
     return [
         LockOrderChecker(),
@@ -277,6 +278,7 @@ def default_checkers() -> list[Checker]:
         DeterminismChecker(),
         JitPurityChecker(),
         MetricRegistryChecker(),
+        SpanRegistryChecker(),
         ConfigParityChecker(),
     ]
 

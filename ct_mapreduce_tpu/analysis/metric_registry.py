@@ -34,14 +34,30 @@ def key_matches(call_key: str, doc_key: str) -> bool:
             or re.fullmatch(doc_re, call_key) is not None)
 
 
-def documented_keys(doc_text: str) -> set[str]:
-    """Backtick-quoted keys from the registry's bullet lines."""
+def bullet_keys(doc_text: str, span_sections: bool = False) -> set[str]:
+    """Backtick-quoted keys from the registry's bullet lines.
+
+    docs/METRICS.md holds TWO registries in one file: metric keys and
+    — under headings containing "Trace spans" (round 23) — span names.
+    ``span_sections`` selects which side's bullets to return, so the
+    metric rule never flags a span bullet as a stale metric and the
+    span rule (analysis/span_registry.py) never reads a counter."""
     keys = set()
+    in_span = False
     for line in doc_text.splitlines():
-        m = re.match(r"- `([^`]+)`", line.strip())
-        if m:
+        stripped = line.strip()
+        if stripped.startswith("#"):
+            in_span = "trace spans" in stripped.lower()
+            continue
+        m = re.match(r"- `([^`]+)`", stripped)
+        if m and in_span == span_sections:
             keys.add(m.group(1))
     return keys
+
+
+def documented_keys(doc_text: str) -> set[str]:
+    """Backtick-quoted METRIC keys (the non-span sections)."""
+    return bullet_keys(doc_text, span_sections=False)
 
 
 class MetricRegistryChecker(Checker):

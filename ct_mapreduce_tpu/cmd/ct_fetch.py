@@ -215,6 +215,21 @@ def main(argv: list[str] | None = None) -> int:
     # import). Near-zero cost when off; exported at shutdown.
     if config.trace_path:
         trace.enable(config.trace_path)
+    # Cross-process correlation (round 23): every span this process
+    # emits carries its fleet worker id; the leader-epoch attr joins in
+    # FleetService._observe_epoch as epochs advance.
+    trace.set_process_attrs(worker=fleet_worker_id)
+    # Fleet observability knobs: fan-in on/off + the SLO thresholds
+    # (directives > CTMR_SLO_* env > platform profile > disabled).
+    from ct_mapreduce_tpu.telemetry import fleetobs
+    from ct_mapreduce_tpu.telemetry import metrics as _metrics
+
+    obs = fleetobs.resolve_obs(
+        fleet_metrics=config.fleet_metrics,
+        max_ingest_lag=config.slo_max_ingest_lag,
+        max_ckpt_age_s=config.slo_max_checkpoint_age,
+        max_filter_lag=config.slo_max_filter_lag,
+        max_serve_p99_ms=config.slo_max_serve_p99_ms)
     # Flight recorder: a crash, SIGTERM/SIGUSR1, or wedged-pipeline
     # latch dumps the trace ring + last metric snapshots next to the
     # run (CTMR_FLIGHT_DIR overrides the directory). Signal dumps ride
@@ -386,14 +401,48 @@ def main(argv: list[str] | None = None) -> int:
     # `checkpointPeriod` — each worker checkpoints (aggregate snapshot
     # + cursors) when it observes the epoch advance — and a clean-
     # shutdown broadcast that stops every worker's downloaders.
+    ckpt_period_s = (parse_duration(checkpoint_period)
+                     if checkpoint_period else 0.0)
+
+    def slo_state() -> tuple[dict, list]:
+        """One SLO rule evaluation (telemetry/fleetobs.py): raw
+        signals → (slo values, breach reasons), mirrored into the
+        ``slo.*`` gauges. Cheap no-op until a threshold is set."""
+        if not obs.any_slo():
+            return {}, []
+        snap = _metrics.get_sink().snapshot()
+        ckpt_wall = fleet.last_checkpoint_wall if fleet is not None else 0.0
+        f_lag = None
+        if fleet is not None and query_server is not None:
+            tier = getattr(query_server.oracle, "filter_tier", None)
+            if tier is not None:
+                f_lag = max(0, int(fleet.stats()["checkpoint_epoch"])
+                            - int(tier.epoch))
+        p99 = fleetobs.serve_p99_ms() if obs.max_serve_p99_ms else None
+        values, degraded = fleetobs.evaluate_slos(
+            obs, snap, last_checkpoint_wall=ckpt_wall,
+            checkpoint_period_s=ckpt_period_s,
+            filter_epoch_lag=f_lag, p99_ms=p99)
+        fleetobs.publish_slo_gauges(values, degraded)
+        return values, degraded
+
+    def obs_payload() -> str:
+        """The heartbeat-cadence fan-in unit: this worker's metrics
+        snapshot + fleet stats + SLO state + a (wall, mono) clock
+        pair, published through the coordinator fabric's TTL'd keys."""
+        values, degraded = slo_state()
+        return fleetobs.build_obs_payload(
+            fleet_worker_id, num_workers,
+            fleet_stats=fleet.stats() if fleet is not None else None,
+            slo={"values": values, "degraded": degraded})
+
     fleet = None
     if num_workers > 1 or coord_backend or checkpoint_period:
         coordinator = build_coordinator(
             coord_backend, _cache, "ct-fetch", fleet_worker_id, num_workers)
         fleet = FleetService(
             coordinator,
-            checkpoint_period_s=(parse_duration(checkpoint_period)
-                                 if checkpoint_period else 0.0),
+            checkpoint_period_s=ckpt_period_s,
             on_checkpoint=lambda epoch: (engine.checkpoint_now(),
                                          leader_fleet_filter(),
                                          publish_distribution(epoch)),
@@ -402,7 +451,28 @@ def main(argv: list[str] | None = None) -> int:
                       file=sys.stderr),
                 engine.signal_stop(),
             ),
+            obs_payload=obs_payload if obs.fleet_metrics else None,
         )
+
+    # Flight-recorder fleet sections (round 23): a SIGUSR1/crash dump
+    # from a wedged worker answers role/epoch/claims/heartbeat-age and
+    # current checkpoint chain depth without a live process to query.
+    def _flight_fleet() -> dict:
+        return fleet.stats() if fleet is not None else {}
+
+    def _flight_ckpt_chain() -> dict:
+        agg = model.aggregator if model is not None else None
+        if agg is None:
+            return {}
+        return {
+            "chain_length": int(getattr(agg, "_ckpt_chain_len", 0)),
+            "last_checkpoint_wall": (fleet.last_checkpoint_wall
+                                     if fleet is not None else 0.0),
+            "checkpoint_period_s": ckpt_period_s,
+        }
+
+    flight.register_section("fleet", _flight_fleet)
+    flight.register_section("ckpt_chain", _flight_ckpt_chain)
 
     health = None
     if config.health_addr:
@@ -441,6 +511,14 @@ def main(argv: list[str] | None = None) -> int:
             body["serve"] = query_server.oracle.stats()
         if fleet is not None:
             body["fleet"] = fleet.stats()
+        # SLO rules (round 23): any breach renders the same body under
+        # HTTP 503 (promhttp's healthy-False contract).
+        values, degraded = slo_state()
+        if values:
+            body["slo"] = values
+        if degraded:
+            body["healthy"] = False
+            body["degraded"] = degraded
         return body
 
     # Query plane: the batched membership-oracle JSON API over the live
@@ -464,6 +542,9 @@ def main(argv: list[str] | None = None) -> int:
                 filter_fp_rate=filter_fp,
                 distrib_history=config.distrib_history,
                 max_delta_chain=config.max_delta_chain).start()
+            # SLO degradation flips the query plane's /healthz to 503
+            # too (same rules, same reasons — satellite of round 23).
+            query_server.slo_check = lambda: slo_state()[1]
             print(f"query endpoint: :{query_server.port}/query "
                   f"+ /issuer + /getcert + /filter "
                   f"(+ /filter/delta + /filter/container + "
@@ -477,11 +558,29 @@ def main(argv: list[str] | None = None) -> int:
 
     metrics_server = None
     if config.metrics_port:
+        # Fleet fan-in routes (round 23): any worker answers for the
+        # whole fleet from the fabric's TTL'd obs payloads.
+        fleet_metrics_fn = fleet_health_fn = None
+        if fleet is not None and obs.fleet_metrics:
+            def fleet_metrics_fn() -> str:
+                return fleetobs.render_fleet_metrics(
+                    fleetobs.collect_fleet_obs(fleet.fleet_obs()))
+
+            def fleet_health_fn() -> dict:
+                return fleetobs.fleet_health(
+                    fleetobs.collect_fleet_obs(fleet.fleet_obs()),
+                    num_workers,
+                    getattr(fleet.coordinator, "liveness_timeout_s",
+                            15.0))
         try:
             metrics_server = MetricsServer(
-                config.metrics_port, health=healthz).start()
+                config.metrics_port, health=healthz,
+                fleet_metrics=fleet_metrics_fn,
+                fleet_health=fleet_health_fn).start()
             print(f"metrics endpoint: :{metrics_server.port}/metrics "
-                  f"+ /healthz", file=sys.stderr)
+                  f"+ /healthz"
+                  + (" + /metrics/fleet + /healthz/fleet"
+                     if fleet_metrics_fn else ""), file=sys.stderr)
         except OSError as err:
             print(f"metrics endpoint disabled: {err}", file=sys.stderr)
             metrics_server = None
@@ -629,6 +728,9 @@ def main(argv: list[str] | None = None) -> int:
             path = trace.export()
             if path:
                 print(f"trace written to {path}", file=sys.stderr)
+        flight.unregister_section("fleet")
+        flight.unregister_section("ckpt_chain")
+        trace.set_process_attrs(worker=None, epoch=None)
         flight.uninstall()
         for signum, prev in prev_handlers.items():
             with contextlib.suppress(ValueError, OSError):

@@ -134,6 +134,21 @@ class CTConfig:
     # mandatory base anchor (0 = CTMR_CKPT_MAX_CHAIN env, then 8)
     ckpt_segment_budget_mb: int = 0  # dirty-log cap per tick; beyond
     # it the save anchors (0 = CTMR_CKPT_SEGMENT_BUDGET_MB, then 256)
+    fleet_metrics: Optional[bool] = None  # publish this worker's
+    # metrics snapshot through the coordinator fabric each heartbeat
+    # and serve /metrics/fleet + /healthz/fleet (unset =
+    # CTMR_FLEET_METRICS env, then on — round 23)
+    slo_max_ingest_lag: int = 0  # SLO: max entries between the ingest
+    # cursor and the STH tree head before /healthz degrades
+    # (0 = CTMR_SLO_MAX_INGEST_LAG env, then disabled)
+    slo_max_checkpoint_age: float = 0.0  # SLO: max seconds since the
+    # last durable checkpoint, graded against max(this,
+    # checkpointPeriod) (0 = CTMR_SLO_MAX_CKPT_AGE_S, then disabled)
+    slo_max_filter_lag: int = 0  # SLO: max epochs the published filter
+    # may trail the checkpoint epoch (0 = CTMR_SLO_MAX_FILTER_LAG env,
+    # then disabled)
+    slo_max_serve_p99_ms: float = 0.0  # SLO: max span-derived serve
+    # p99 in ms (0 = CTMR_SLO_MAX_SERVE_P99_MS env, then disabled)
     verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
@@ -200,6 +215,11 @@ class CTConfig:
         "checkpointMode": ("checkpoint_mode", str),
         "ckptMaxChain": ("ckpt_max_chain", int),
         "ckptSegmentBudgetMB": ("ckpt_segment_budget_mb", int),
+        "fleetMetrics": ("fleet_metrics", bool),
+        "sloMaxIngestLag": ("slo_max_ingest_lag", int),
+        "sloMaxCheckpointAge": ("slo_max_checkpoint_age", float),
+        "sloMaxFilterLag": ("slo_max_filter_lag", int),
+        "sloMaxServeP99Ms": ("slo_max_serve_p99_ms", float),
     }
 
     @classmethod
@@ -455,6 +475,27 @@ class CTConfig:
             "ckptSegmentBudgetMB = per-tick dirty-log budget; a tick "
             "whose churn exceeds it anchors with a full base instead "
             "(CTMR_CKPT_SEGMENT_BUDGET_MB equivalent; default 256)",
+            "fleetMetrics = publish this worker's metrics snapshot "
+            "through the coordinator fabric each heartbeat and serve "
+            "the /metrics/fleet + /healthz/fleet fan-in "
+            "(CTMR_FLEET_METRICS equivalent; default on — the payload "
+            "rides a heartbeat already being sent)",
+            "sloMaxIngestLag = degrade /healthz (HTTP 503) when any "
+            "log's ingest cursor trails its STH tree head by more "
+            "than this many entries (CTMR_SLO_MAX_INGEST_LAG "
+            "equivalent; 0 = disabled)",
+            "sloMaxCheckpointAge = degrade /healthz when the last "
+            "durable checkpoint is older than this many seconds, "
+            "graded against max(threshold, checkpointPeriod) so a "
+            "threshold tighter than the cadence cannot flap "
+            "(CTMR_SLO_MAX_CKPT_AGE_S equivalent; 0 = disabled)",
+            "sloMaxFilterLag = degrade /healthz when the published "
+            "filter epoch trails the checkpoint epoch by more than "
+            "this many epochs (CTMR_SLO_MAX_FILTER_LAG equivalent; "
+            "0 = disabled)",
+            "sloMaxServeP99Ms = degrade /healthz when the span-"
+            "derived serve p99 exceeds this many milliseconds "
+            "(CTMR_SLO_MAX_SERVE_P99_MS equivalent; 0 = disabled)",
             "",
             "Diagnostics (env only):",
             "CTMR_LOCK_WITNESS=1 wraps every lock the package creates "

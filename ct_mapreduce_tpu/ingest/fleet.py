@@ -58,13 +58,14 @@ from datetime import datetime, timedelta, timezone
 from typing import Callable, Iterable, Optional, Protocol, Sequence
 
 from ct_mapreduce_tpu.config import profile as platprofile
-from ct_mapreduce_tpu.telemetry import metrics
+from ct_mapreduce_tpu.telemetry import metrics, trace
 
 # Cache key namespaces (alongside the reference's leader-/started-).
 HEARTBEAT_KEY_PREFIX = "fleet-hb-"
 EPOCH_KEY_PREFIX = "fleet-epoch-"
 STOP_KEY_PREFIX = "fleet-stop-"
 CLAIM_KEY_PREFIX = "fleet-claim-"
+OBS_KEY_PREFIX = "fleet-obs-"
 
 # A shutdown broadcast only needs to outlive every worker's observation
 # poll (sub-second); the TTL bounds how long a stale broadcast can
@@ -199,7 +200,11 @@ class FleetCoordinator(Protocol):
     without waiting for full membership. ``claim_log``/``release_log``
     are the per-log fetch lease: at most one worker holds a log at a
     time, so partition-map disagreement windows (dead-owner takeover
-    racing the owner's warm restart) cannot double-fetch."""
+    racing the owner's warm restart) cannot double-fetch.
+    ``publish_obs``/``fleet_obs`` carry each worker's TTL'd
+    observability payload (metrics snapshot + clock pair, compact
+    JSON) over the same value fabric — the metrics fan-in feed behind
+    ``/metrics/fleet`` and ``/healthz/fleet``."""
 
     worker_id: int
     num_workers: int
@@ -217,6 +222,8 @@ class FleetCoordinator(Protocol):
     def shutdown_requested(self) -> Optional[str]: ...
     def claim_log(self, log_url: str) -> bool: ...
     def release_log(self, log_url: str) -> None: ...
+    def publish_obs(self, payload: str) -> None: ...
+    def fleet_obs(self) -> dict[int, str]: ...
     def close(self) -> None: ...
 
 
@@ -274,6 +281,13 @@ class SoloFleetCoordinator:
     def release_log(self, log_url: str) -> None:
         pass
 
+    def publish_obs(self, payload: str) -> None:
+        self._obs = payload
+
+    def fleet_obs(self) -> dict[int, str]:
+        obs = getattr(self, "_obs", None)
+        return {0: obs} if obs is not None else {}
+
     def close(self) -> None:
         pass
 
@@ -329,6 +343,9 @@ class CacheFleetCoordinator:
     def _claim_key(self, log_url: str) -> str:
         digest = hashlib.sha256(log_url.encode()).hexdigest()[:16]
         return f"{CLAIM_KEY_PREFIX}{self.name}-{digest}"
+
+    def _obs_key(self, worker_id: int) -> str:
+        return f"{OBS_KEY_PREFIX}{self.name}-{worker_id}"
 
     def _clear_key(self, key: str) -> None:
         """RemoteCache has no DEL; EXPIREAT in the past is the
@@ -466,6 +483,23 @@ class CacheFleetCoordinator:
         if self.cache.get(self._claim_key(log_url)) == str(self.worker_id):
             self._clear_key(self._claim_key(log_url))
 
+    # -- observability fan-in ---------------------------------------------
+    def publish_obs(self, payload: str) -> None:
+        """TTL'd like the heartbeat: a stalled worker's payload ages
+        out of the fleet view on the same liveness clock that marks it
+        dead, so the rollup never reports fresh-looking numbers from a
+        SIGSTOP'd process."""
+        self.cache.put(self._obs_key(self.worker_id), payload,
+                       life=timedelta(seconds=self.liveness_timeout_s))
+
+    def fleet_obs(self) -> dict[int, str]:
+        out: dict[int, str] = {}
+        for w in range(self.num_workers):
+            raw = self.cache.get(self._obs_key(w))
+            if raw is not None:
+                out[w] = raw
+        return out
+
     def close(self) -> None:
         self._coord.close()
 
@@ -571,6 +605,26 @@ class JaxFleetCoordinator:
     def release_log(self, log_url: str) -> None:
         pass
 
+    def publish_obs(self, payload: str) -> None:
+        from ct_mapreduce_tpu.parallel import distributed
+
+        if not distributed.kv_put(self._kv(f"obs/{self.worker_id}"),
+                                  payload):
+            self._local_obs = payload
+
+    def fleet_obs(self) -> dict[int, str]:
+        from ct_mapreduce_tpu.parallel import distributed
+
+        out: dict[int, str] = {}
+        for w in range(self.num_workers):
+            raw = distributed.kv_get(self._kv(f"obs/{w}"))
+            if raw is not None:
+                out[w] = raw
+        local = getattr(self, "_local_obs", None)
+        if local is not None and self.worker_id not in out:
+            out[self.worker_id] = local
+        return out
+
     def close(self) -> None:
         self._coord.close()
 
@@ -590,6 +644,17 @@ def build_coordinator(backend: str, cache, name: str, worker_id: int,
     if be in ("redis", "cache"):
         if cache is None:
             raise ValueError("coordinatorBackend=redis needs a RemoteCache")
+        if "liveness_timeout_s" not in kwargs:
+            # CTMR_FLEET_LIVENESS_S shrinks the liveness TTL for test
+            # harnesses that must observe a dead worker quickly (the
+            # obs-smoke SIGSTOP leg); unparseable values are ignored,
+            # matching the config layer's env tolerance.
+            raw = os.environ.get("CTMR_FLEET_LIVENESS_S", "")
+            try:
+                if raw and float(raw) > 0:
+                    kwargs["liveness_timeout_s"] = float(raw)
+            except ValueError:
+                pass
         return CacheFleetCoordinator(
             cache, name, worker_id, num_workers, **kwargs)
     if be == "jax":
@@ -615,7 +680,8 @@ class FleetService:
                  heartbeat_period_s: float = 2.0,
                  checkpoint_period_s: float = 0.0,
                  on_checkpoint: Optional[Callable[[int], None]] = None,
-                 on_shutdown: Optional[Callable[[str], None]] = None):
+                 on_shutdown: Optional[Callable[[str], None]] = None,
+                 obs_payload: Optional[Callable[[], str]] = None):
         self.coordinator = coordinator
         self.worker_id = coordinator.worker_id
         self.num_workers = coordinator.num_workers
@@ -623,9 +689,15 @@ class FleetService:
         self.checkpoint_period_s = max(0.0, float(checkpoint_period_s))
         self.on_checkpoint = on_checkpoint
         self.on_shutdown = on_shutdown
+        # Zero-arg compact-JSON provider published into the fabric on
+        # every heartbeat (telemetry/fleetobs.py builds it) — the
+        # metrics fan-in + clock-pair exchange feed.
+        self.obs_payload = obs_payload
+        self.obs_publishes = 0
         self.is_leader = False
         self.rejoined = False
         self.checkpoints_run = 0
+        self.last_checkpoint_wall = 0.0
         self._epoch_seen = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -683,6 +755,7 @@ class FleetService:
                 if now >= next_beat:
                     self.coordinator.heartbeat()
                     self._renew_claims()
+                    self._publish_obs()
                     next_beat = now + self.heartbeat_period_s
                     self._observe_liveness()
                 if (next_epoch_tick is not None and self.is_leader
@@ -708,16 +781,37 @@ class FleetService:
         if not self.is_leader and self.coordinator.maybe_promote():
             self.is_leader = True
 
+    def _publish_obs(self) -> None:
+        if self.obs_payload is None:
+            return
+        try:
+            payload = self.obs_payload()
+        except Exception:
+            return  # a snapshot failure must not stop the heartbeat
+        if payload:
+            self.coordinator.publish_obs(payload)
+            self.obs_publishes += 1
+            metrics.incr_counter("fleet", "obs_publishes")
+
+    def fleet_obs(self) -> dict[int, str]:
+        """Every live worker's published observability payload
+        (worker id → compact JSON string, this worker included)."""
+        return self.coordinator.fleet_obs()
+
     def _observe_epoch(self) -> None:
         epoch = self.coordinator.current_epoch()
         if epoch <= self._epoch_seen:
             return
         self._epoch_seen = epoch
         metrics.set_gauge("fleet", "checkpoint_epoch", value=float(epoch))
+        # Cross-process correlation: every span this worker records
+        # from here on carries the observed leader epoch.
+        trace.set_process_attrs(epoch=epoch)
         if self.on_checkpoint is not None:
             with metrics.measure("fleet", "checkpoint_s"):
                 self.on_checkpoint(epoch)
         self.checkpoints_run += 1
+        self.last_checkpoint_wall = time.time()
         metrics.incr_counter("fleet", "checkpoint_count")
 
     def _observe_shutdown(self) -> None:
@@ -820,6 +914,8 @@ class FleetService:
                                 for w, a in sorted(alive.items())},
             "checkpoint_epoch": self._epoch_seen,
             "checkpoints_run": self.checkpoints_run,
+            "last_checkpoint_wall": self.last_checkpoint_wall,
+            "obs_publishes": self.obs_publishes,
             "partition": partition,
         }
         if stripe is not None:

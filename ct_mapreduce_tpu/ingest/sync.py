@@ -966,10 +966,21 @@ class LogWorker:
                 self.end_pos = tree_end
         self.position = self.start_pos
         self.last_entry_time: Optional[datetime] = None
+        self._publish_lag()
         # External checkpoint trigger (fleet epoch ticks): the download
         # loop saves at the next batch boundary when set — same thread
         # as the periodic ticker saves, so no new concurrency.
         self._save_signal = threading.Event()
+
+    def _publish_lag(self) -> None:
+        """Ingest-lag gauge (round 23): entries between the cursor and
+        the STH tree head for this worker's range — the raw signal the
+        SLO layer (telemetry/fleetobs.py) compares against
+        ``sloMaxIngestLag``. Keyed per log so multi-log runs expose the
+        worst log, not a blended number."""
+        lag = max(0, self.end_pos + 1 - self.position)
+        metrics.set_gauge("ingest", "lag_entries", self.client.short_url,
+                          value=float(lag))
 
     def request_save(self) -> None:
         """Ask the download loop to checkpoint (cursor + pre_save
@@ -1066,6 +1077,7 @@ class LogWorker:
                             ts / 1000.0, tz=timezone.utc
                         )
                         break
+                self._publish_lag()
                 if progress is not None:
                     progress(self.client.short_url, self.position, self.end_pos)
                 if (self._save_signal.is_set()
@@ -1117,6 +1129,7 @@ class LogWorker:
                     # resume must re-fetch it.
                     break
                 self.position = raw.index + 1
+                self._publish_lag()
                 if progress is not None:
                     progress(self.client.short_url, self.position, self.end_pos)
                 if (self._save_signal.is_set()

@@ -53,7 +53,8 @@ class DeadlineExceeded(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("items", "deadline", "enq_t", "done", "result", "error")
+    __slots__ = ("items", "deadline", "enq_t", "done", "result", "error",
+                 "trace_ctx")
 
     def __init__(self, items: list, deadline: Optional[float],
                  enq_t: float) -> None:
@@ -63,6 +64,9 @@ class _Pending:
         self.done = threading.Event()
         self.result: Optional[list] = None
         self.error: Optional[Exception] = None
+        # Cross-process correlation (round 23): the submitter's trace
+        # context crosses to the batch worker thread with the request.
+        self.trace_ctx = trace.get_trace_context()
 
 
 class MicroBatcher:
@@ -222,9 +226,17 @@ class MicroBatcher:
             if not live:
                 continue
             flat = [it for p in live for it in p.items]
+            # Single-context batches adopt the submitter's trace ids on
+            # this worker thread, so serve.batch and everything the
+            # oracle nests under it correlate with the client's request;
+            # a coalesced batch spanning traces stays untagged (one span
+            # cannot honestly belong to several traces).
+            ctxs = {p.trace_ctx for p in live if p.trace_ctx is not None}
+            only = ctxs.pop() if len(ctxs) == 1 else (None,)
             try:
-                with trace.span("serve.batch", cat="serve",
-                                lanes=len(flat), requests=len(live)):
+                with trace.trace_context(*only), \
+                        trace.span("serve.batch", cat="serve",
+                                   lanes=len(flat), requests=len(live)):
                     results = self._run_batch(flat)
                 if len(results) != len(flat):
                     raise RuntimeError(

@@ -1,7 +1,13 @@
 """Client for the query plane (``serve/server.py``) — the transport
 behind the ``ct-query`` binary and ``ct-getcert``'s ``queryPort``
 routing. Stdlib-only (urllib), no streaming: requests are small JSON
-documents by design (the batching happens server-side)."""
+documents by design (the batching happens server-side).
+
+Round 23 cross-process correlation: every request mints a
+W3C-traceparent-style header (telemetry/trace.py) and wraps itself in
+a ``query.client`` span carrying the same trace_id — the server side
+extracts the header and tags its serve spans with it, so
+``traceview --merge`` shows one request crossing both processes."""
 
 from __future__ import annotations
 
@@ -9,6 +15,8 @@ import json
 import urllib.error
 import urllib.request
 from typing import Optional
+
+from ct_mapreduce_tpu.telemetry import trace
 
 
 class QueryError(RuntimeError):
@@ -38,20 +46,24 @@ class QueryClient:
     def _request(self, path: str, payload: Optional[dict] = None) -> dict:
         url = self.base_url + path
         data = None
-        headers = {}
+        header, trace_id, span_id = trace.mint_traceparent()
+        headers = {trace.TRACEPARENT_HEADER: header}
         if payload is not None:
             data = json.dumps(payload).encode()
             headers["Content-Type"] = "application/json"
         req = urllib.request.Request(url, data=data, headers=headers)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                return json.loads(resp.read().decode())
-        except urllib.error.HTTPError as err:
+        with trace.trace_context(trace_id, span_id), \
+                trace.span("query.client", "serve", path=path):
             try:
-                body = json.loads(err.read().decode())
-            except (ValueError, OSError):
-                body = {"error": str(err)}
-            raise QueryError(err.code, body) from None
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as resp:
+                    return json.loads(resp.read().decode())
+            except urllib.error.HTTPError as err:
+                try:
+                    body = json.loads(err.read().decode())
+                except (ValueError, OSError):
+                    body = {"error": str(err)}
+                raise QueryError(err.code, body) from None
 
     def query(self, queries: list[dict],
               timeout_ms: Optional[int] = None) -> dict:

@@ -425,6 +425,9 @@ class QueryServer:
             distrib_history=distrib_history,
             max_delta_chain=max_delta_chain)
         self._transport = transport
+        # Optional SLO probe (round 23): a callable returning the
+        # current breach-reason list; non-empty flips /healthz to 503.
+        self.slo_check = None
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -613,8 +616,18 @@ class QueryServer:
         from ct_mapreduce_tpu.telemetry.metrics import get_sink
 
         counters = get_sink().snapshot().get("counters", {})
+        # SLO hook (round 23): ct-fetch attaches its rule evaluation;
+        # any breach renders the same JSON body under HTTP 503 so load
+        # balancers act on the code while operators read the reasons.
+        degraded: list = []
+        if self.slo_check is not None:
+            try:
+                degraded = list(self.slo_check())
+            except Exception as err:  # the probe must answer, not 500
+                degraded = [f"slo check failed: "
+                            f"{type(err).__name__}: {err}"]
         body = {
-            "healthy": True,
+            "healthy": not degraded,
             **self.oracle.stats(),
             "shed_total": counters.get("serve.shed", 0.0),
             "batches_total": counters.get("serve.batches", 0.0),
@@ -623,7 +636,9 @@ class QueryServer:
             "device_fallback_total": counters.get(
                 "serve.device_fallback", 0.0),
         }
-        return 200, body
+        if degraded:
+            body["degraded"] = degraded
+        return (503 if degraded else 200), body
 
     def handle_getcert(self, params: dict) -> tuple[int, dict]:
         log_url = params.get("log")
@@ -667,6 +682,16 @@ class QueryServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _trace_ctx(self):
+                """Cross-process correlation (round 23): adopt the
+                client's traceparent header so every span this request
+                produces on this thread carries its trace_id."""
+                ids = trace.parse_traceparent(
+                    self.headers.get(trace.TRACEPARENT_HEADER, "") or "")
+                if ids is None:
+                    return trace.trace_context(None)
+                return trace.trace_context(*ids)
+
             def _respond(self, code: int, body, headers=None) -> None:
                 if isinstance(body, (bytes, bytearray)):
                     payload, ctype = bytes(body), "application/octet-stream"
@@ -703,7 +728,8 @@ class QueryServer:
                     self._respond(400, {"error": f"bad request: {err}"})
                     return
                 try:
-                    self._respond(*server.handle_query(body))
+                    with self._trace_ctx():
+                        self._respond(*server.handle_query(body))
                 except Exception as err:  # the server must answer
                     self._respond(
                         500, {"error": f"{type(err).__name__}: {err}"})
@@ -711,6 +737,10 @@ class QueryServer:
             def do_GET(self):  # noqa: N802
                 raw_path, _, qs = self.path.partition("?")
                 path = raw_path.rstrip("/") or "/"
+                with self._trace_ctx():
+                    self._dispatch_get(path, qs)
+
+            def _dispatch_get(self, path: str, qs: str) -> None:
                 try:
                     if path == "/healthz":
                         self._respond(*server.handle_healthz())

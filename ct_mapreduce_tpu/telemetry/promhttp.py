@@ -80,14 +80,26 @@ class MetricsServer:
     optional callable returning the ``/healthz`` JSON dict — a
     ``"healthy": False`` entry turns the response into a 503, anything
     else (including no provider) is 200. Port 0 binds an ephemeral
-    port, resolved on :meth:`start` (tests use this)."""
+    port, resolved on :meth:`start` (tests use this).
+
+    Round 23 fleet fan-in: ``fleet_metrics`` (callable returning a
+    full text exposition — telemetry/fleetobs.render_fleet_metrics
+    over the coordinator fabric's obs payloads) adds
+    ``GET /metrics/fleet``; ``fleet_health`` (callable returning the
+    fleetobs.fleet_health rollup dict) adds ``GET /healthz/fleet``
+    with the same ``healthy: False`` → 503 contract. Both 404 when
+    their provider is absent — a solo worker's surface is unchanged."""
 
     def __init__(self, port: int, host: str = "0.0.0.0", sink=None,
-                 health: Optional[Callable[[], dict]] = None):
+                 health: Optional[Callable[[], dict]] = None,
+                 fleet_metrics: Optional[Callable[[], str]] = None,
+                 fleet_health: Optional[Callable[[], dict]] = None):
         self.host = host
         self.port = int(port)
         self._sink = sink
         self._health = health
+        self._fleet_metrics = fleet_metrics
+        self._fleet_health = fleet_health
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -108,6 +120,20 @@ class MetricsServer:
         body.setdefault("healthy", code == 200)
         return code, body
 
+    def fleet_healthz(self) -> tuple[int, dict]:
+        """The ``/healthz/fleet`` rollup with the same 503 contract as
+        the per-process probe — the body always renders (a load
+        balancer acts on the code, an operator reads the JSON)."""
+        try:
+            body = dict(self._fleet_health())
+        except Exception as err:  # the rollup must answer, not 500
+            return 503, {"healthy": False,
+                         "error": f"{type(err).__name__}: {err}"}
+        code = 503 if body.get("healthy") is False else 200
+        body.setdefault("healthy", code == 200)
+        body.setdefault("time", time.time())
+        return code, body
+
     def start(self) -> "MetricsServer":
         server = self
 
@@ -120,6 +146,21 @@ class MetricsServer:
                     code = 200
                 elif path == "/healthz":
                     code, body = server.healthz()
+                    payload = json.dumps(body).encode()
+                    ctype = "application/json"
+                elif (path == "/metrics/fleet"
+                        and server._fleet_metrics is not None):
+                    try:
+                        payload = server._fleet_metrics().encode()
+                        code = 200
+                    except Exception as err:  # scrape must answer
+                        payload = (f"# fleet fan-in failed: "
+                                   f"{type(err).__name__}: {err}\n").encode()
+                        code = 503
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif (path == "/healthz/fleet"
+                        and server._fleet_health is not None):
+                    code, body = server.fleet_healthz()
                     payload = json.dumps(body).encode()
                     ctype = "application/json"
                 else:

@@ -31,6 +31,18 @@ import, so every entry point — ct-fetch, bench, tests — gets it for
 free) or the ``tracePath`` config directive / an explicit
 :func:`enable` call. When a path is set, the ring is exported there at
 interpreter exit; callers may also :func:`export` eagerly.
+
+Cross-process correlation (round 23): spans can carry a
+``trace_id``/``parent_id`` pair. A request thread establishes the pair
+with :func:`trace_context` (typically parsed from a W3C-style
+``traceparent`` header minted by :func:`mint_traceparent`) and every
+span recorded on that thread while the context is active is tagged.
+:func:`set_process_attrs` stamps process-wide identity (fleet
+``worker``, leader ``epoch``) onto every event, and exports carry a
+``mono_t0`` anchor on ``time.monotonic()`` — the clock the coordinator
+fabric's (wall, monotonic) pairs reference — so
+``tools/traceview.py --merge`` can place per-process rings on one
+skew-corrected timeline.
 """
 
 from __future__ import annotations
@@ -44,6 +56,109 @@ from collections import deque
 from typing import Optional
 
 DEFAULT_RING = 1 << 16  # events; ~25 MB worst case, bounds long runs
+
+# -- cross-process correlation state ------------------------------------
+# Process-wide attrs (fleet worker id, leader epoch) merged into every
+# recorded event; span-local args win on key collisions.
+_proc_attrs: dict = {}
+# Per-thread trace context: (trace_id, parent_id) or absent.
+_ctx = threading.local()
+
+
+def set_process_attrs(**attrs) -> None:
+    """Stamp (or update) process-wide attrs onto every future event.
+    ``None`` values delete the key."""
+    for key, val in attrs.items():
+        if val is None:
+            _proc_attrs.pop(key, None)
+        else:
+            _proc_attrs[key] = val
+
+
+def get_process_attrs() -> dict:
+    return dict(_proc_attrs)
+
+
+def set_trace_context(trace_id: str,
+                      parent_id: Optional[str] = None) -> None:
+    _ctx.ids = (trace_id, parent_id)
+
+
+def clear_trace_context() -> None:
+    _ctx.ids = None
+
+
+def get_trace_context() -> Optional[tuple]:
+    """The calling thread's (trace_id, parent_id), or None."""
+    return getattr(_ctx, "ids", None)
+
+
+class trace_context:
+    """Context manager scoping a (trace_id, parent_id) pair to the
+    calling thread; restores the previous context on exit. A falsy
+    ``trace_id`` makes it a no-op (so callers can pass a parse result
+    straight through)."""
+
+    __slots__ = ("_ids", "_prev")
+
+    def __init__(self, trace_id: Optional[str],
+                 parent_id: Optional[str] = None):
+        self._ids = (trace_id, parent_id) if trace_id else None
+
+    def __enter__(self):
+        self._prev = getattr(_ctx, "ids", None)
+        if self._ids is not None:
+            _ctx.ids = self._ids
+        return self
+
+    def __exit__(self, *exc):
+        _ctx.ids = self._prev
+        return False
+
+
+# -- W3C-traceparent-style header helpers -------------------------------
+# Wire shape: "00-<32 hex trace_id>-<16 hex span_id>-01" (version and
+# sampled flag fixed; only the two ids are meaningful here).
+
+TRACEPARENT_HEADER = "traceparent"
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def mint_traceparent() -> tuple[str, str, str]:
+    """(header_value, trace_id, span_id) for a new client-side root."""
+    trace_id, span_id = new_trace_id(), new_span_id()
+    return f"00-{trace_id}-{span_id}-01", trace_id, span_id
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[tuple]:
+    """(trace_id, span_id) from a traceparent header, or None on any
+    malformation — propagation must never reject a request."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, _flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(version, 16), int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
 
 
 class _NullSpan:
@@ -107,9 +222,12 @@ class SpanTracer:
         # deque.append is GIL-atomic: the hot path never takes a lock.
         self._events: deque = deque(maxlen=self.ring_size)
         self._t0_ns = time.perf_counter_ns()
-        # Wall-clock anchor so post-mortem readers can place the
-        # monotonic timestamps in real time.
+        # Anchors recorded back to back: wall-clock (place the ring in
+        # real time) and CLOCK_MONOTONIC (the clock the fleet fabric's
+        # (wall, monotonic) pairs reference — the skew-correction base
+        # for tools/traceview.py --merge).
         self.wall_t0 = time.time()
+        self.mono_t0 = time.monotonic()
         self._pid = os.getpid()
         self._threads_lock = threading.Lock()
         self._thread_names: dict[int, str] = {}
@@ -128,6 +246,21 @@ class SpanTracer:
                     tid, threading.current_thread().name)
         return tid
 
+    def _tagged_args(self, args) -> Optional[dict]:
+        """Span args merged with the process attrs and the calling
+        thread's trace context (span-local args win)."""
+        ids = getattr(_ctx, "ids", None)
+        if not _proc_attrs and ids is None:
+            return dict(args) if args else None
+        merged = dict(_proc_attrs)
+        if ids is not None:
+            merged["trace_id"] = ids[0]
+            if ids[1]:
+                merged["parent_id"] = ids[1]
+        if args:
+            merged.update(args)
+        return merged
+
     def _complete(self, name: str, cat: str, t0_ns: int, t1_ns: int,
                   args) -> None:
         ev = {
@@ -140,8 +273,9 @@ class SpanTracer:
         }
         if cat:
             ev["cat"] = cat
-        if args:
-            ev["args"] = dict(args)
+        tagged = self._tagged_args(args)
+        if tagged:
+            ev["args"] = tagged
         self._events.append(ev)
 
     def span(self, name: str, cat: str = "", **args) -> _Span:
@@ -158,8 +292,9 @@ class SpanTracer:
         }
         if cat:
             ev["cat"] = cat
-        if args:
-            ev["args"] = dict(args)
+        tagged = self._tagged_args(args)
+        if tagged:
+            ev["args"] = tagged
         self._events.append(ev)
 
     # -- reading / export ------------------------------------------------
@@ -187,6 +322,9 @@ class SpanTracer:
             "traceEvents": self.events(),
             "displayTimeUnit": "ms",
             "otherData": {"wall_t0": self.wall_t0,
+                          "mono_t0": self.mono_t0,
+                          "pid": self._pid,
+                          "process_attrs": get_process_attrs(),
                           "ring_size": self.ring_size},
         }
         try:

@@ -36,6 +36,7 @@ SECTIONS = {
     "filter": ("ct_mapreduce_tpu.filter", "_FILTER_KNOBS"),
     "distrib": ("ct_mapreduce_tpu.distrib", "_DISTRIB_KNOBS"),
     "ckpt": ("ct_mapreduce_tpu.agg.ckpt", "_CKPT_KNOBS"),
+    "obs": ("ct_mapreduce_tpu.telemetry.fleetobs", "_OBS_KNOBS"),
 }
 
 # Declared ladders, coarse-to-fine in the order the search walks them.
@@ -63,6 +64,7 @@ SWEEPABLE = {
     },
     "distrib": {},
     "ckpt": {},
+    "obs": {},
 }
 
 # Knobs the search must not touch, each with its justification.
@@ -116,6 +118,19 @@ EXCLUDED = {
         "ckptSegmentBudgetMB": "dirty-log memory ceiling is an "
                                "operator host-RAM policy, not a "
                                "measured performance rate",
+    },
+    "obs": {
+        "fleetMetrics": "observability on/off toggle: enables the "
+                        "fan-in, does not tune a measured rate",
+        "sloMaxIngestLag": "SLO threshold is an operator service "
+                           "objective, never a swept performance "
+                           "scalar",
+        "sloMaxCheckpointAge": "SLO threshold encodes the data-loss "
+                               "budget — operator policy, not speed",
+        "sloMaxFilterLag": "SLO threshold is a freshness objective "
+                           "for filter consumers, not a measured rate",
+        "sloMaxServeP99Ms": "SLO threshold is the latency objective "
+                            "being judged — sweeping it is circular",
     },
 }
 

@@ -141,7 +141,8 @@ def test_cli_rule_selection_and_listing(capsys):
     assert main(["--list-rules"]) == 0
     out = capsys.readouterr().out.split()
     assert {"lock-order", "donation-safety", "determinism",
-            "jit-purity", "metric-registry", "config-parity"} == set(out)
+            "jit-purity", "metric-registry", "span-registry",
+            "config-parity"} == set(out)
     # Single-rule run over the real package stays clean too.
     assert main([str(PKG), "--rules", "lock-order",
                  "--baseline", "none"]) == 0

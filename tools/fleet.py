@@ -212,7 +212,10 @@ def write_worker_ini(path: str, fixture: dict, state_path: str,
                      batch_size: int = 64, table_bits: int = 12,
                      coordinator: str = "", emit_filter: bool = True,
                      query_port: int = 0,
-                     run_forever: bool = False) -> None:
+                     run_forever: bool = False,
+                     trace_path: str = "",
+                     metrics_port: int = 0,
+                     extra_lines: tuple = ()) -> None:
     lines = [
         f"logList = {','.join(fixture['logs'])}",
         "backend = tpu",
@@ -252,6 +255,13 @@ def write_worker_ini(path: str, fixture: dict, state_path: str,
     if run_forever:
         lines += ["runForever = true", "pollingDelayMean = 1s",
                   "pollingDelayStdDev = 0"]
+    if trace_path:
+        # Per-worker span ring (round 23): the obs smoke merges these
+        # into one skew-corrected timeline (traceview --merge).
+        lines.append(f"tracePath = {trace_path}")
+    if metrics_port:
+        lines.append(f"metricsPort = {metrics_port}")
+    lines += list(extra_lines)
     with open(path, "w") as fh:
         fh.write("\n".join(lines) + "\n")
 
@@ -303,6 +313,8 @@ def child_main(args) -> int:
         batch_size=args.batch_size, table_bits=args.table_bits,
         coordinator=args.coordinator, query_port=args.query_port,
         run_forever=args.run_forever,
+        trace_path=args.trace_path, metrics_port=args.metrics_port,
+        extra_lines=tuple(args.ini_line or ()),
     )
     from ct_mapreduce_tpu.cmd import ct_fetch
     from ct_mapreduce_tpu.ingest.fleet import (
@@ -338,7 +350,11 @@ def spawn_worker(worker_id: int, workers: int, fixture_path: str,
                  compile_cache: bool = True,
                  compile_cache_readonly: bool = False,
                  query_port: int = 0,
-                 run_forever: bool = False) -> subprocess.Popen:
+                 run_forever: bool = False,
+                 trace_path: str = "",
+                 metrics_port: int = 0,
+                 ini_lines: tuple = (),
+                 extra_env: dict = None) -> subprocess.Popen:
     """Spawn one worker process. Pass ``compile_cache=False`` (no
     persistent cache) for every process involved in a kill-and-resume
     sequence. Observed on this jax/XLA CPU build (stress data in
@@ -377,6 +393,14 @@ def spawn_worker(worker_id: int, workers: int, fixture_path: str,
         argv += ["--query-port", str(query_port)]
     if run_forever:
         argv += ["--run-forever"]
+    if trace_path:
+        argv += ["--trace-path", trace_path]
+    if metrics_port:
+        argv += ["--metrics-port", str(metrics_port)]
+    for line in ini_lines:
+        argv += ["--ini-line", line]
+    if extra_env:
+        env.update(extra_env)
     return subprocess.Popen(argv, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True, env=env)
 
@@ -502,6 +526,11 @@ def main(argv=None) -> int:
     ap.add_argument("--throttle-ms", type=float, default=0.0)
     ap.add_argument("--query-port", type=int, default=0)
     ap.add_argument("--run-forever", action="store_true")
+    ap.add_argument("--trace-path", default="")
+    ap.add_argument("--metrics-port", type=int, default=0)
+    ap.add_argument("--ini-line", action="append", default=[],
+                    help="extra raw config line(s) for the worker ini "
+                         "(e.g. 'sloMaxIngestLag = 10')")
     ap.add_argument("--logs", type=int, default=4)
     ap.add_argument("--entries-per-log", type=int, default=256)
     ap.add_argument("--dupes", type=int, default=16)

@@ -10,6 +10,18 @@ genuinely overlapping.
 
 Usage:
   python tools/traceview.py /tmp/trace.json [--stages name1,name2,...]
+  python tools/traceview.py --merge w0.json w1.json ... \
+      [--skew pairs.json] [--out merged.json]
+
+``--merge`` (round 23) stitches the per-process trace files of a live
+``tools/fleet.py`` run into ONE Perfetto-loadable timeline: each file
+is shifted onto the corrected wall clock using the (wall, monotonic)
+pairs the workers exchanged through the coordinator fabric (``--skew``
+is a JSON object ``{"<worker_id>": {"wall": ..., "mono": ...}}`` —
+e.g. fleetobs.clock_pairs_from_obs output; without it each trace's own
+startup pair is used, exact on a shared-boot host). Tracks are named
+per worker/pid, so one ct-query request reads as one flow across both
+processes under one ``trace_id``.
 
 Also importable: ``load(path)`` / ``stage_summary(events)`` are the
 parsing half of bench.py's span-derived smoke occupancy and of
@@ -34,6 +46,44 @@ def load(path: str) -> list[dict]:
     if isinstance(doc, list):
         return doc
     raise ValueError(f"{path}: not a Chrome trace-event JSON")
+
+
+def load_doc(path: str) -> dict:
+    """Read one trace file as a full doc (``otherData`` kept — the
+    merge needs the clock anchors and process attrs)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, list):
+        doc = {"traceEvents": doc, "otherData": {}}
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a Chrome trace-event JSON")
+    return doc
+
+
+def merge(paths: list[str], skew_path: str = "",
+          out_path: str = "") -> dict:
+    """Stitch per-process traces into one skew-corrected doc; writes
+    ``out_path`` when given. The correction math lives in
+    telemetry/fleetobs.py (unit-tested there)."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from ct_mapreduce_tpu.telemetry import fleetobs
+
+    pairs = None
+    if skew_path:
+        with open(skew_path) as fh:
+            raw = json.load(fh)
+        pairs = {int(k): v for k, v in raw.items()
+                 if isinstance(v, dict) and "wall" in v and "mono" in v}
+    merged = fleetobs.merge_traces([load_doc(p) for p in paths],
+                                   pairs=pairs)
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(merged, fh)
+    return merged
 
 
 def complete_spans(events: list[dict]) -> list[dict]:
@@ -93,13 +143,35 @@ def stage_summary(events: list[dict], stages=None,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="Chrome trace-event JSON path")
+    ap.add_argument("trace", nargs="+",
+                    help="Chrome trace-event JSON path(s); several "
+                         "with --merge")
     ap.add_argument("--stages", default="",
                     help="comma-separated span names to include "
                          "(default: all)")
+    ap.add_argument("--merge", action="store_true",
+                    help="stitch per-process traces into one "
+                         "skew-corrected timeline")
+    ap.add_argument("--skew", default="",
+                    help="worker→(wall, mono) clock-pair JSON from the "
+                         "coordinator fabric (with --merge)")
+    ap.add_argument("--out", default="",
+                    help="write the merged trace here (with --merge)")
     args = ap.parse_args(argv)
     stages = [s for s in args.stages.split(",") if s] or None
-    events = load(args.trace)
+    if args.merge:
+        merged = merge(args.trace, skew_path=args.skew,
+                       out_path=args.out)
+        n = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
+        print(f"merged {merged['otherData']['merged_from']} traces, "
+              f"{n} events"
+              + (f" -> {args.out}" if args.out else ""))
+        events = merged["traceEvents"]
+    elif len(args.trace) > 1:
+        print("multiple trace files need --merge", file=sys.stderr)
+        return 2
+    else:
+        events = load(args.trace[0])
     summary = stage_summary(events, stages=stages)
     wall = summary.pop("_wall_s")
     if not summary:
