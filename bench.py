@@ -1803,23 +1803,27 @@ def run_verify_smoke() -> dict:
         kind = s % 9
         if kind in (0, 1, 2, 3):
             der = sctlib.attach_sct(base, p256, 10**12 + s,
-                                    corrupt_signature=(kind == 3))
+                                    corrupt_signature=(kind == 3),
+                                    issuer_der=issuer)
             truth["device"] += 1
             truth["verified" if kind != 3 else "failed"] += 1
         elif kind == 4:
-            der = sctlib.attach_sct(base, p384, 10**12 + s)
+            der = sctlib.attach_sct(base, p384, 10**12 + s,
+                                    issuer_der=issuer)
             truth["device"] += 1  # P-384 rides the device since r17
             truth["verified"] += 1
         elif kind == 5:
             der = sctlib.attach_sct(base, rsa, 10**12 + s,
-                                    corrupt_signature=True)
+                                    corrupt_signature=True,
+                                    issuer_der=issuer)
             truth["fallback"] += 1
             truth["failed"] += 1
         elif kind in (6, 7):
             der = base
             truth["no_sct"] += 1
         else:
-            der = sctlib.attach_sct(base, unknown, 10**12 + s)
+            der = sctlib.attach_sct(base, unknown, 10**12 + s,
+                                    issuer_der=issuer)
             truth["no_key"] += 1
         pairs.append(der)
 
@@ -1907,6 +1911,145 @@ def run_verify_smoke() -> dict:
         "smoke_verify_qtable_misses": st["qtable_misses"],
         "smoke_verify_window": sink.verifier.window,
         "smoke_verify_wall_s": wall,
+    }
+
+
+def run_audit_smoke() -> dict:
+    """CT_BENCH_SMOKE audit leg (round 24): the recorded-shard audit
+    pipeline at tier-1 scale, CPU-only.
+
+    Replays the checked-in ``CTMRAU01`` shard (tests/data/
+    recorded_shard.json.gz, 1024 entries signed by production-schema
+    fixture logs) tiled to >= 10^5 entries through the FULL audit
+    path — decode, native/mirror quarantine diff, log-list routing,
+    device+host signature verification, per-issuer aggregation — and
+    enforces:
+
+      (1) every driver tally equals the fixture's MIX-derived ground
+          truth × tile (verified/failed/no-key/retired/out-of-interval
+          /device/host/no-sct — one wrong lane class anywhere fails);
+      (2) the per-issuer verified/failed folds equal a HOST-recomputed
+          oracle: one tile's SCT lanes re-extracted and re-verified
+          lane-by-lane with the pure-python reference verifier,
+          grouped by issuer key hash, scaled by tile;
+      (3) quarantined == 0 PINNED — the native scanner and the python
+          mirror agree on every real-corpus lane (a single divergence
+          is a parity bug, not noise), and divergence was MEASURED
+          whenever the native extractor is present;
+      (4) tool-flow scale is linear by construction (the same driver
+          tiles to >= 10^6: ``python tools/audit.py --recorded
+          tests/data/recorded_shard.json.gz --tile 978``).
+
+    Device batches pad to width 32 (the tier-1 parity suite's compiled
+    width, so one process compiles each kernel once).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ct_mapreduce_tpu.audit import driver as audrvlib
+    from ct_mapreduce_tpu.audit import fixture as auditfx
+    from ct_mapreduce_tpu.audit import loglist as loglistlib
+    from ct_mapreduce_tpu.ingest import leaf as leaflib
+    from ct_mapreduce_tpu.verify import sct as sctlib
+
+    tile = int(os.environ.get("CT_BENCH_SMOKE_AUDIT_TILE", "98"))
+    shard = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tests", "data", "recorded_shard.json.gz")
+    doc = audrvlib.load_recorded(shard)
+    log_list = loglistlib.parse_log_list(doc["log_list"])
+
+    t0 = time.monotonic()
+    drv = audrvlib.AuditDriver(log_list, batch_width=32)
+    rep = drv.run_recorded(doc, tile=tile)
+    wall = time.monotonic() - t0
+
+    want = auditfx.expected_tallies()
+    for name, got in (("entries", rep.entries),
+                      ("sct_lanes", rep.sct_lanes),
+                      ("no_sct", rep.no_sct),
+                      ("verified", rep.verified),
+                      ("failed", rep.failed),
+                      ("no_key", rep.verifier_no_key),
+                      ("device_lanes", rep.device_lanes),
+                      ("host_lanes", rep.host_lanes),
+                      ("retired", rep.retired),
+                      ("out_of_interval", rep.out_of_interval),
+                      ("unknown_log", rep.unknown_log)):
+        if got != want[name] * tile:
+            raise BenchError(
+                f"audit smoke tally: {name}={got} != "
+                f"{want[name]} x tile {tile}")
+    if rep.quarantined != 0:
+        raise BenchError(
+            f"audit smoke: {rep.quarantined} lanes quarantined on the "
+            f"real corpus — native/mirror extraction parity broke")
+    try:
+        from ct_mapreduce_tpu.native import load as _load_native
+
+        native_ok = (os.environ.get("CTMR_NATIVE", "1") != "0"
+                     and _load_native() is not None
+                     and getattr(_load_native(), "has_sct", False))
+    except Exception:
+        native_ok = False
+    if native_ok and not rep.divergence_measured:
+        raise BenchError("audit smoke: native extractor present but "
+                         "divergence was not measured")
+
+    # Host-recomputed per-issuer oracle: ONE tile, every lane
+    # re-extracted and re-verified with the pure-python reference,
+    # grouped by issuer key hash (byte-identical tiles scale by tile).
+    reg = log_list.registry()
+    oracle: dict = {}
+    for page in doc["pages"]:
+        start = int(page.get("start", 0))
+        for i, e in enumerate(page["entries"]):
+            dec = leaflib.decode_json_entry(start + i, e)
+            ikh = (sctlib.issuer_key_hash_of(dec.issuer_der)
+                   if dec.issuer_der else sctlib.ZERO_IKH)
+            status, sct, digest, _, _ = sctlib.extract_sct_lane(
+                dec.cert_der, ikh)
+            if status == sctlib.SCT_NONE or sct is None:
+                continue
+            key = reg.get(sct.log_id)
+            if key is None:
+                continue  # no_key lanes fold into no per-issuer row
+            ok = sctlib.host_verify_sct(digest, sct, key)
+            v, f = oracle.get(ikh, (0, 0))
+            oracle[ikh] = (v + int(ok), f + int(not ok))
+    want_folds = sorted((v * tile, f * tile)
+                        for v, f in oracle.values())
+    got_folds = sorted(rep.per_issuer.values())
+    if want_folds != got_folds:
+        raise BenchError(
+            f"audit smoke per-issuer oracle: driver folds {got_folds} "
+            f"!= host-recomputed {want_folds}")
+
+    log(f"audit smoke: {rep.entries} entries (tile {tile}) in "
+        f"{wall:.1f}s — verified {rep.verified} / failed {rep.failed} "
+        f"/ no-key {rep.verifier_no_key}; flagged retired "
+        f"{rep.retired}, out-of-interval {rep.out_of_interval}; "
+        f"quarantined {rep.quarantined} "
+        f"(measured={rep.divergence_measured}); "
+        f"{len(rep.per_issuer)} issuer folds host-verified")
+    return {
+        "metric": "ct_audit_smoke",
+        "value": rep.entries / max(wall, 1e-9),
+        "unit": "entries/s",
+        "smoke_audit_entries": rep.entries,
+        "smoke_audit_tile": tile,
+        "smoke_audit_verified": rep.verified,
+        "smoke_audit_failed": rep.failed,
+        "smoke_audit_no_key": rep.verifier_no_key,
+        "smoke_audit_retired": rep.retired,
+        "smoke_audit_out_of_interval": rep.out_of_interval,
+        "smoke_audit_unknown_log": rep.unknown_log,
+        "smoke_audit_device_lanes": rep.device_lanes,
+        "smoke_audit_host_lanes": rep.host_lanes,
+        "smoke_audit_quarantined": rep.quarantined,
+        "smoke_audit_divergence_measured": int(rep.divergence_measured),
+        "smoke_audit_per_issuer_groups": len(rep.per_issuer),
+        "smoke_audit_wall_s": wall,
     }
 
 

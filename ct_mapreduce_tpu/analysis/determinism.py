@@ -55,6 +55,11 @@ SCOPE_MODULES: tuple[str, ...] = (
     # into a chain (targetSha256 per link); a nondeterministic byte
     # breaks tip continuation across a restart.
     "ct_mapreduce_tpu/agg/ckpt.py",
+    # Round 24 — quarantine spool records are content-addressed
+    # (<sha256[:24]>.json) and replay feeds the differential harness;
+    # a clock or hash-order byte would break the spool's dedup-by-
+    # content contract and the replayed-vs-dropped identity test.
+    "ct_mapreduce_tpu/audit/quarantine.py",
 )
 
 # (module pattern, function name): serialization paths inside
@@ -65,6 +70,9 @@ SCOPE_FUNCTIONS: tuple[tuple[str, str], ...] = (
     ("ct_mapreduce_tpu/agg/aggregator.py", "_save_full"),
     ("ct_mapreduce_tpu/agg/aggregator.py", "_save_segment"),
     ("ct_mapreduce_tpu/agg/aggregator.py", "_ckpt_segment_blob"),
+    # Round 24 — the checked-in recorded-shard fixture must be
+    # byte-stable across regenerations (mtime=0, sorted keys).
+    ("ct_mapreduce_tpu/audit/driver.py", "write_recorded"),
 )
 
 _WALL_CLOCK = {

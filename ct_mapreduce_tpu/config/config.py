@@ -149,6 +149,10 @@ class CTConfig:
     # then disabled)
     slo_max_serve_p99_ms: float = 0.0  # SLO: max span-derived serve
     # p99 in ms (0 = CTMR_SLO_MAX_SERVE_P99_MS env, then disabled)
+    audit_log_list: str = ""  # log-list v3 JSON path for the audit
+    # subsystem ("" = CTMR_AUDIT_LOG_LIST env, then unset — round 24)
+    audit_quarantine_dir: str = ""  # durable divergence spool ("" =
+    # CTMR_AUDIT_QUARANTINE_DIR env, then in-memory only)
     verbosity: int = 0  # glog-style -v level (flag only, not a directive)
 
     _DIRECTIVES = {
@@ -220,6 +224,8 @@ class CTConfig:
         "sloMaxCheckpointAge": ("slo_max_checkpoint_age", float),
         "sloMaxFilterLag": ("slo_max_filter_lag", int),
         "sloMaxServeP99Ms": ("slo_max_serve_p99_ms", float),
+        "auditLogList": ("audit_log_list", str),
+        "auditQuarantineDir": ("audit_quarantine_dir", str),
     }
 
     @classmethod
@@ -496,6 +502,14 @@ class CTConfig:
             "sloMaxServeP99Ms = degrade /healthz when the span-"
             "derived serve p99 exceeds this many milliseconds "
             "(CTMR_SLO_MAX_SERVE_P99_MS equivalent; 0 = disabled)",
+            "auditLogList = log-list v3 JSON (production Google/Apple "
+            "schema) loaded as the audit subsystem's trust anchors "
+            "(CTMR_AUDIT_LOG_LIST equivalent; unset = audit runs "
+            "must name a list or use a recorded shard's embedded one)",
+            "auditQuarantineDir = durable spool for native-vs-mirror "
+            "divergence quarantine records (CTMR_AUDIT_QUARANTINE_DIR "
+            "equivalent; unset = lanes are still excluded from "
+            "aggregates, records stay in memory)",
             "",
             "Diagnostics (env only):",
             "CTMR_LOCK_WITNESS=1 wraps every lock the package creates "

@@ -295,13 +295,19 @@ def publish(report: DivergenceReport) -> None:
 TREND_FORMAT = "CTMRDV01"
 
 
-def record_trend(report: DivergenceReport, path: str) -> dict:
+def record_trend(report: DivergenceReport, path: str,
+                 corpus: str = "fuzz") -> dict:
     """Append one classified run's bucket counts to the JSON trend
     file at ``path`` (created if missing) and return the updated
-    document. The first recorded run pins ``floorDeviceAcceptRate``;
-    later runs only append — the floor is a ratchet an operator (or a
-    deliberate re-baseline) moves, never a harness run. Written
-    tmp+replace like every durable artifact in the tree."""
+    document. Runs are tagged with their ``corpus``: ``fuzz`` (the
+    synthesized mutation corpora) pins ``floorDeviceAcceptRate`` on
+    its first run, ``real`` (recorded-shard DER — round 24) pins
+    ``floorRealAcceptRate`` separately, because a mutation corpus is
+    built to be mostly rejected while a real shard should be almost
+    entirely accepted — one floor cannot grade both. Later runs only
+    append — each floor is a ratchet an operator (or a deliberate
+    re-baseline) moves, never a harness run. Written tmp+replace like
+    every durable artifact in the tree."""
     import json as _json
     import os as _os
     import tempfile as _tempfile
@@ -316,6 +322,7 @@ def record_trend(report: DivergenceReport, path: str) -> dict:
                              f"{doc.get('format')!r}")
     entry = {
         "run": len(doc["runs"]) + 1,
+        "corpus": corpus,
         "total": report.total,
         "deviceAccepts": report.device_accepts,
         "hostAccepts": report.host_accepts,
@@ -327,8 +334,10 @@ def record_trend(report: DivergenceReport, path: str) -> dict:
         "deviceAcceptRate": round(report.device_accept_rate, 6),
     }
     doc["runs"].append(entry)
-    if doc.get("floorDeviceAcceptRate") is None:
-        doc["floorDeviceAcceptRate"] = entry["deviceAcceptRate"]
+    floor_key = ("floorRealAcceptRate" if corpus == "real"
+                 else "floorDeviceAcceptRate")
+    if doc.get(floor_key) is None:
+        doc[floor_key] = entry["deviceAcceptRate"]
     fd, tmp = _tempfile.mkstemp(
         prefix=_os.path.basename(path) + ".tmp.",
         dir=_os.path.dirname(_os.path.abspath(path)))
@@ -345,10 +354,12 @@ def record_trend(report: DivergenceReport, path: str) -> dict:
     return doc
 
 
-def trend_floor(path: str):
-    """The recorded ``parse.device_accept_rate`` floor at ``path``,
-    or None when no trend has been recorded yet. The tier-1 gate
-    asserts a fresh harness run never drops below this."""
+def trend_floor(path: str, corpus: str = "fuzz"):
+    """The recorded accept-rate floor at ``path`` for the given
+    corpus class (``fuzz`` → ``floorDeviceAcceptRate``, ``real`` →
+    ``floorRealAcceptRate``), or None when none has been recorded
+    yet. The tier-1 gates assert a fresh harness run never drops
+    below its class's floor."""
     import json as _json
     import os as _os
 
@@ -359,4 +370,5 @@ def trend_floor(path: str):
     if doc.get("format") != TREND_FORMAT:
         raise ValueError(f"unknown trend format in {path}: "
                          f"{doc.get('format')!r}")
-    return doc.get("floorDeviceAcceptRate")
+    return doc.get("floorRealAcceptRate" if corpus == "real"
+                   else "floorDeviceAcceptRate")

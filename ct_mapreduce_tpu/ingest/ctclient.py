@@ -6,10 +6,14 @@ Mirrors the reference's use of certificate-transparency-go's
 
 - entries are fetched in ranges of up to 1000 per request
   (ct-fetch.go:417); the server may return fewer — callers advance by
-  what they got;
-- HTTP 429 triggers a jittered exponential backoff of 500 ms – 5 min
-  and a retry of the same range (ct-fetch.go:409-437), honoring
-  Retry-After when present;
+  what they got, and the client remembers the server's observed page
+  size so later windows ask for what the log actually serves (real
+  logs cap get-entries far below the spec maximum);
+- HTTP 429 AND transient 5xx (500/502/503/504 — real logs shed load
+  with these at least as often as with 429) trigger a jittered
+  exponential backoff of 500 ms – 5 min and a retry of the same range
+  (ct-fetch.go:409-437), honoring Retry-After when present; retries
+  are counted under ``ingest.retry.*`` by status;
 - other HTTP errors raise and are handled by the caller's
   log-level error policy.
 
@@ -31,6 +35,10 @@ from ct_mapreduce_tpu.telemetry.metrics import incr_counter, measure
 from ct_mapreduce_tpu.utils.backoff import JitteredBackoff
 
 BATCH_SIZE = 1000  # entries per get-entries request (ct-fetch.go:417)
+
+# Statuses retried with backoff instead of raised: rate limiting plus
+# the transient 5xx family production logs answer under load.
+RETRYABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
 
 Transport = Callable[[str], tuple[int, dict, bytes]]
 
@@ -95,19 +103,27 @@ class CTLogClient:
         self.transport = transport or _urllib_transport
         self.sleep = sleep
         self.max_retries = max_retries
+        # Adaptive get-entries window: starts at the spec maximum and
+        # clamps down to the page size the server actually returns.
+        self.page_size = BATCH_SIZE
 
     # -- plumbing --------------------------------------------------------
     def _get_json(self, path: str) -> dict:
         url = f"{self.log_url}/ct/v1/{path}"
         backoff = JitteredBackoff(min_s=0.5, max_s=300.0)
+        status = 429
         for _ in range(self.max_retries):
             status, headers, body = self.transport(url)
             if status == 200:
                 return json.loads(body)
-            if status == 429:
+            if status in RETRYABLE_STATUSES:
                 # ct-fetch.go:426-437: jittered 500ms-5min, honor
-                # Retry-After seconds when the server sends one.
-                incr_counter("LogWorker", self.short_url, "429")
+                # Retry-After seconds when the server sends one. 5xx
+                # takes the exact same lane — a 503 from an overloaded
+                # log is rate limiting by another name.
+                if status == 429:
+                    incr_counter("LogWorker", self.short_url, "429")
+                incr_counter("ingest", "retry", str(status))
                 retry_after = next(
                     (v for k, v in headers.items()
                      if k.lower() == "retry-after"),
@@ -127,7 +143,8 @@ class CTLogClient:
                 self.sleep(delay)
                 continue
             raise CTClientError(url, status, body)
-        raise CTClientError(url, 429, b"retry budget exhausted")
+        incr_counter("ingest", "retry", "giveup")
+        raise CTClientError(url, status, b"retry budget exhausted")
 
     # -- API -------------------------------------------------------------
     def get_sth(self) -> SignedTreeHead:
@@ -142,13 +159,22 @@ class CTLogClient:
 
     def get_raw_entries(self, start: int, end: int) -> list[RawEntry]:
         """Entries ``[start, end]`` inclusive, like ct-go's
-        GetRawEntries; the server may truncate the range."""
+        GetRawEntries; the server may truncate the range. The first
+        truncated response clamps this client's window to the page
+        size the server demonstrated, so every later request asks for
+        exactly what the log serves instead of re-discovering the cap
+        one oversized range at a time."""
         if end < start:
             return []
-        end = min(end, start + BATCH_SIZE - 1)
+        end = min(end, start + self.page_size - 1)
         with measure("LogWorker", self.short_url, "getRawEntries"):
             obj = self._get_json(f"get-entries?start={start}&end={end}")
         entries = obj.get("entries", [])
+        if 0 < len(entries) < end - start + 1:
+            # Short page on a full-window ask: adopt the server's size.
+            if len(entries) < self.page_size:
+                self.page_size = len(entries)
+                incr_counter("ingest", "window_clamp")
         return [
             RawEntry(
                 index=start + i,

@@ -493,10 +493,24 @@ class AggregatorSink:
         verify_eligible = None
         if self.verifier is not None:
             from ct_mapreduce_tpu.native import leafpack as _lp
+            from ct_mapreduce_tpu.verify import sct as _sctlib
 
+            # RFC 6962 precert digests sign the per-lane
+            # issuer_key_hash: SHA-256 of the chain issuer's SPKI,
+            # computed once per issuer GROUP (a handful per batch) and
+            # broadcast per lane; lanes without a mapped issuer hash
+            # as all-zero and can only verify against fixture SCTs
+            # signed the same way.
+            ikh_groups = np.zeros((len(dec.group_issuers) + 1, 32),
+                                  np.uint8)
+            for g, der in enumerate(dec.group_issuers):
+                ikh_groups[g] = np.frombuffer(
+                    _sctlib.issuer_key_hash_of(der), np.uint8)
+            lane_ikh = ikh_groups[np.where(valid, grp, -1)]
             scts = _lp.extract_scts(
                 data, dec.length,
-                threads=self.decode_threads or self.decode_workers)
+                threads=self.decode_threads or self.decode_workers,
+                issuer_key_hash=lane_ikh)
             verify_eligible = valid.copy()
 
         # Pre-parsed lane: extract walker-exact sidecars on the host

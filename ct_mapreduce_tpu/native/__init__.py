@@ -155,23 +155,27 @@ def load() -> Optional[ctypes.CDLL]:
             lib.has_mt = True
         except AttributeError:
             lib.has_mt = False
-        # SCT extraction (round 13). Same stale-library contract as
-        # has_mt: a cached .so from before the verify lane loads fine,
-        # callers check `has_sct` and use the python extractor.
+        # SCT extraction (round 13; _v2 since round 24 — the RFC 6962
+        # precert digest takes a per-lane issuer_key_hash input, so the
+        # symbol is renamed: a stale pre-round-24 .so lacks it and
+        # degrades to the python extractor instead of being called with
+        # a mismatched signature). Same stale-library contract as
+        # has_mt: callers check `has_sct`.
         try:
-            lib.ctmr_extract_scts.restype = None
-            lib.ctmr_extract_scts.argtypes = [
+            lib.ctmr_extract_scts_v2.restype = None
+            lib.ctmr_extract_scts_v2.argtypes = [
                 ctypes.c_int64,
                 u8p, ctypes.c_int64, i32p,
+                u8p,
                 u8p,
                 u8p, u8p,
                 i64p,
                 u8p, u8p,
                 u8p, u8p,
             ]
-            lib.ctmr_extract_scts_mt.restype = None
-            lib.ctmr_extract_scts_mt.argtypes = (
-                lib.ctmr_extract_scts.argtypes + [ctypes.c_int64]
+            lib.ctmr_extract_scts_v2_mt.restype = None
+            lib.ctmr_extract_scts_v2_mt.argtypes = (
+                lib.ctmr_extract_scts_v2.argtypes + [ctypes.c_int64]
             )
             lib.has_sct = True
         except AttributeError:

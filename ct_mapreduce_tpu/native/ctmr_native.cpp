@@ -1025,6 +1025,10 @@ inline Tlv tlv(const uint8_t* d, int64_t off, int64_t end) {
 
 static const uint8_t kSctOid[10] = {0x2b, 0x06, 0x01, 0x04, 0x01,
                                     0xd6, 0x79, 0x02, 0x04, 0x02};
+// 1.3.6.1.4.1.11129.2.4.3 — the precert poison (RFC 6962 §3.1),
+// stripped alongside the SCT list during TBS reconstruction.
+static const uint8_t kPoisonOid[10] = {0x2b, 0x06, 0x01, 0x04, 0x01,
+                                       0xd6, 0x79, 0x02, 0x04, 0x03};
 
 struct ExtWin {
   int64_t tlv_off = 0, tlv_end = 0, val_off = 0, val_end = 0;
@@ -1129,13 +1133,16 @@ inline SctFields parse_sct_list(const uint8_t* b, int64_t n) {
 }
 
 // Mirror of sct.py::parse_ecdsa_sig with max_bytes = 32: big-endian
-// 32-byte outputs, or false (fallback lane).
+// 32-byte outputs, or false (fallback lane). Staged through locals —
+// the python parser accepts or rejects the whole signature at once,
+// so a failure after r parsed must leave r_out untouched (partial
+// writes would diverge from the mirror on fallback lanes).
 inline bool parse_ecdsa_sig32(const uint8_t* s, int64_t n,
                               uint8_t* r_out, uint8_t* s_out) {
   Tlv seq = tlv(s, 0, n);
   if (!seq.ok || seq.tag != 0x30 || seq.off + seq.len != n) return false;
   int64_t off = seq.off, end = seq.off + seq.len;
-  uint8_t* outs[2] = {r_out, s_out};
+  uint8_t vals[2][32];
   for (int k = 0; k < 2; ++k) {
     Tlv v = tlv(s, off, end);
     if (!v.ok || v.tag != 0x02 || v.len < 1) return false;
@@ -1145,11 +1152,142 @@ inline bool parse_ecdsa_sig32(const uint8_t* s, int64_t n,
     while (a < b - 1 && s[a] == 0) ++a;
     int64_t w = b - a;
     if (w > 32) return false;
-    for (int i = 0; i < 32; ++i) outs[k][i] = 0;
-    for (int64_t i = 0; i < w; ++i) outs[k][32 - w + i] = s[a + i];
+    for (int i = 0; i < 32; ++i) vals[k][i] = 0;
+    for (int64_t i = 0; i < w; ++i) vals[k][32 - w + i] = s[a + i];
     off = v.off + v.len;
   }
-  return off == end;
+  if (off != end) return false;
+  std::memcpy(r_out, vals[0], 32);
+  std::memcpy(s_out, vals[1], 32);
+  return true;
+}
+
+// Minimal-DER header (mirror of sct.py::_wrap_tlv): writes tag +
+// length octets into out (<= 5 bytes), returns the header size.
+inline int wrap_hdr(int tag, int64_t len, uint8_t* out) {
+  out[0] = (uint8_t)tag;
+  if (len < 0x80) { out[1] = (uint8_t)len; return 2; }
+  if (len < 0x100) { out[1] = 0x81; out[2] = (uint8_t)len; return 3; }
+  if (len < 0x10000) {
+    out[1] = 0x82; out[2] = (uint8_t)(len >> 8); out[3] = (uint8_t)len;
+    return 4;
+  }
+  out[1] = 0x83; out[2] = (uint8_t)(len >> 16);
+  out[3] = (uint8_t)(len >> 8); out[4] = (uint8_t)len;
+  return 5;
+}
+
+inline bool strip_oid(const uint8_t* d, const Tlv& oid) {
+  return oid.len == 10 &&
+         (std::memcmp(d + oid.off, kSctOid, 10) == 0 ||
+          std::memcmp(d + oid.off, kPoisonOid, 10) == 0);
+}
+
+// RFC 6962 §3.2 signed payload, streamed (mirror of sct.py::
+// sct_digest over reconstruct_precert_tbs, bit-identical — no
+// materialized TBS buffer): header ‖ issuer_key_hash ‖ len3(tbs') ‖
+// tbs' ‖ ext_len ‖ ext, where tbs' re-encodes the TBS with every
+// SCT/poison extension removed and minimal lengths throughout.
+// Returns false when the certificate doesn't parse to the extractor's
+// acceptance (the caller then reports the lane as SCT_NONE, matching
+// the python mirror).
+inline bool digest_precert(const uint8_t* der, int64_t n,
+                           const SctFields& f, const uint8_t* ikh,
+                           uint8_t* out32) {
+  Tlv cert = tlv(der, 0, n);
+  if (!cert.ok || cert.tag != 0x30) return false;
+  Tlv tbs = tlv(der, cert.off, cert.off + cert.len);
+  if (!tbs.ok || tbs.tag != 0x30) return false;
+  int64_t tbs_end = tbs.off + tbs.len;
+  int64_t off = tbs.off;
+  Tlv e = tlv(der, off, tbs_end);
+  if (!e.ok) return false;
+  if (e.tag == 0xa0) off = e.off + e.len;
+  for (int i = 0; i < 6; ++i) {
+    e = tlv(der, off, tbs_end);
+    if (!e.ok) return false;
+    off = e.off + e.len;
+  }
+  int64_t a3_off = -1, a3_end = 0, seq_off = 0, seq_len = 0;
+  while (off < tbs_end) {
+    e = tlv(der, off, tbs_end);
+    if (!e.ok) return false;
+    if (e.tag == 0xa3) {
+      a3_off = off;
+      a3_end = e.off + e.len;
+      Tlv seq = tlv(der, e.off, a3_end);
+      if (!seq.ok || seq.tag != 0x30) return false;
+      seq_off = seq.off;
+      seq_len = seq.len;
+      break;
+    }
+    off = e.off + e.len;
+  }
+  // Pass 1: surviving extensions content length.
+  int64_t kept_len = 0;
+  if (a3_off >= 0) {
+    int64_t p = seq_off, p_end = seq_off + seq_len;
+    while (p < p_end) {
+      Tlv ext = tlv(der, p, p_end);
+      if (!ext.ok || ext.tag != 0x30) return false;
+      int64_t ext_end = ext.off + ext.len;
+      Tlv oid = tlv(der, ext.off, ext_end);
+      if (!oid.ok || oid.tag != 0x06) return false;
+      if (!strip_oid(der, oid)) kept_len += ext_end - p;
+      p = ext_end;
+    }
+  }
+  uint8_t seq_hdr[5], a3_hdr[5], tbs_hdr[5];
+  int seq_hl = 0, a3_hl = 0;
+  int64_t a3_total = 0;
+  if (a3_off >= 0 && kept_len > 0) {
+    seq_hl = wrap_hdr(0x30, kept_len, seq_hdr);
+    a3_hl = wrap_hdr(0xa3, seq_hl + kept_len, a3_hdr);
+    a3_total = a3_hl + seq_hl + kept_len;
+  }
+  int64_t content_len =
+      a3_off >= 0
+          ? (a3_off - tbs.off) + a3_total + (tbs_end - a3_end)
+          : tbs.len;
+  int tbs_hl = wrap_hdr(0x30, content_len, tbs_hdr);
+  int64_t tbs_total = tbs_hl + content_len;
+
+  Sha256 sha;
+  uint8_t hdr[12];
+  hdr[0] = 0; hdr[1] = 0;
+  for (int j = 0; j < 8; ++j)
+    hdr[2 + j] = (uint8_t)((uint64_t)f.timestamp >> (56 - 8 * j));
+  hdr[10] = 0; hdr[11] = 1;
+  sha.update(hdr, 12);
+  sha.update(ikh, 32);
+  uint8_t l3[3] = {(uint8_t)(tbs_total >> 16), (uint8_t)(tbs_total >> 8),
+                   (uint8_t)tbs_total};
+  sha.update(l3, 3);
+  sha.update(tbs_hdr, tbs_hl);
+  if (a3_off >= 0) {
+    sha.update(der + tbs.off, a3_off - tbs.off);
+    if (kept_len > 0) {
+      sha.update(a3_hdr, a3_hl);
+      sha.update(seq_hdr, seq_hl);
+      // Pass 2: stream the surviving extension TLVs.
+      int64_t p = seq_off, p_end = seq_off + seq_len;
+      while (p < p_end) {
+        Tlv ext = tlv(der, p, p_end);
+        int64_t ext_end = ext.off + ext.len;
+        Tlv oid = tlv(der, ext.off, ext_end);
+        if (!strip_oid(der, oid)) sha.update(der + p, ext_end - p);
+        p = ext_end;
+      }
+    }
+    sha.update(der + a3_end, tbs_end - a3_end);
+  } else {
+    sha.update(der + tbs.off, tbs.len);
+  }
+  uint8_t el[2] = {(uint8_t)(f.ext_len >> 8), (uint8_t)f.ext_len};
+  sha.update(el, 2);
+  sha.update(f.ext, f.ext_len);
+  sha.finish(out32);
+  return true;
 }
 
 }  // namespace sctext
@@ -1157,13 +1295,17 @@ inline bool parse_ecdsa_sig32(const uint8_t* s, int64_t n,
 extern "C" {
 
 // Embedded-SCT tuples for a packed row batch: status (0 none /
-// 1 device-ready P-256 / 2 host-fallback), the convention digest,
+// 1 device-ready P-256 / 2 host-fallback), the RFC 6962 precert
+// digest (round 24 — reconstructed TBS + per-lane issuer_key_hash),
 // log id, timestamp, and big-endian r/s for status-1 lanes. Keep in
 // lockstep with ct_mapreduce_tpu/verify/sct.py (extract_sct_lane).
-void ctmr_extract_scts(
+// issuer_key_hash: [n, 32] per-lane SHA-256(issuer SPKI), or null
+// (every lane hashes as all-zero — no issuer chain).
+void ctmr_extract_scts_v2(
     int64_t n,
     const uint8_t* data, int64_t pad_len,
     const int32_t* length,
+    const uint8_t* issuer_key_hash,  // [n, 32] or null
     uint8_t* ok,
     uint8_t* digest,      // [n, 32]
     uint8_t* log_id,      // [n, 32]
@@ -1172,6 +1314,7 @@ void ctmr_extract_scts(
     uint8_t* s_out,       // [n, 32]
     uint8_t* hash_alg,
     uint8_t* sig_alg) {
+  static const uint8_t kZeroIkh[32] = {0};
   for (int64_t i = 0; i < n; ++i) {
     ok[i] = 0;
     int64_t len = length[i];
@@ -1182,25 +1325,10 @@ void ctmr_extract_scts(
     sctext::SctFields f =
         sctext::parse_sct_list(der + w.val_off, w.val_end - w.val_off);
     if (!f.ok) continue;
-    // Convention digest: version ‖ sig_type ‖ ts ‖ entry_type ‖
-    // len3(splice) ‖ splice ‖ ext_len ‖ ext  (see verify/sct.py).
-    sctext::Sha256 sha;
-    uint8_t hdr[13];
-    hdr[0] = 0; hdr[1] = 0;
-    for (int j = 0; j < 8; ++j)
-      hdr[2 + j] = (uint8_t)((uint64_t)f.timestamp >> (56 - 8 * j));
-    hdr[10] = 0; hdr[11] = 1;
-    int64_t splice_len = len - (w.tlv_end - w.tlv_off);
-    uint8_t l3[3] = {(uint8_t)(splice_len >> 16), (uint8_t)(splice_len >> 8),
-                     (uint8_t)splice_len};
-    sha.update(hdr, 12);
-    sha.update(l3, 3);
-    sha.update(der, w.tlv_off);
-    sha.update(der + w.tlv_end, len - w.tlv_end);
-    uint8_t el[2] = {(uint8_t)(f.ext_len >> 8), (uint8_t)f.ext_len};
-    sha.update(el, 2);
-    sha.update(f.ext, f.ext_len);
-    sha.finish(digest + i * 32);
+    const uint8_t* ikh =
+        issuer_key_hash ? issuer_key_hash + i * 32 : kZeroIkh;
+    if (!sctext::digest_precert(der, len, f, ikh, digest + i * 32))
+      continue;
     for (int j = 0; j < 32; ++j) log_id[i * 32 + j] = f.log_id[j];
     timestamp_ms[i] = f.timestamp;
     hash_alg[i] = (uint8_t)f.hash_alg;
@@ -1218,10 +1346,11 @@ void ctmr_extract_scts(
   }
 }
 
-void ctmr_extract_scts_mt(
+void ctmr_extract_scts_v2_mt(
     int64_t n,
     const uint8_t* data, int64_t pad_len,
     const int32_t* length,
+    const uint8_t* issuer_key_hash,
     uint8_t* ok, uint8_t* digest, uint8_t* log_id,
     int64_t* timestamp_ms, uint8_t* r_out, uint8_t* s_out,
     uint8_t* hash_alg, uint8_t* sig_alg,
@@ -1232,8 +1361,9 @@ void ctmr_extract_scts_mt(
   if ((int64_t)T > n) T = (int)n;
   pool::WorkerPool::get().run(T, T, [&](int t) {
     int64_t lo = n * t / T, hi = n * (t + 1) / T;
-    ctmr_extract_scts(
+    ctmr_extract_scts_v2(
         hi - lo, data + lo * pad_len, pad_len, length + lo,
+        issuer_key_hash ? issuer_key_hash + lo * 32 : nullptr,
         ok + lo, digest + lo * 32, log_id + lo * 32, timestamp_ms + lo,
         r_out + lo * 32, s_out + lo * 32, hash_alg + lo, sig_alg + lo);
   });

@@ -182,12 +182,15 @@ def _extract_sidecars(data: np.ndarray, length: np.ndarray,
 
 
 def extract_scts(data: np.ndarray, length: np.ndarray,
-                 threads: Optional[int] = None):
+                 threads: Optional[int] = None,
+                 issuer_key_hash: Optional[np.ndarray] = None):
     """Embedded-SCT tuples for packed rows: a
     :class:`ct_mapreduce_tpu.verify.sct.SctBatch` — the host half of
-    the signature-verification lane (status / convention digest /
-    log id / r / s per lane). Native scanner when available
-    (``ctmr_extract_scts``, lane-range threaded like the sidecar
+    the signature-verification lane (status / RFC 6962 precert digest /
+    log id / r / s per lane). ``issuer_key_hash``: uint8[n, 32]
+    per-lane SHA-256(issuer SPKI) signed into each digest (None →
+    all-zero lanes, no issuer chain). Native scanner when available
+    (``ctmr_extract_scts_v2``, lane-range threaded like the sidecar
     pass), else the bit-identical pure-python mirror — unlike the
     sidecar extractor there IS a python fallback, because the verify
     lane has no device walker to fall back onto."""
@@ -200,7 +203,7 @@ def extract_scts(data: np.ndarray, length: np.ndarray,
         lib = (None if os.environ.get("CTMR_NATIVE", "1") == "0"
                else load_native())
         if lib is None or not getattr(lib, "has_sct", False):
-            return extract_scts_np(data, length)
+            return extract_scts_np(data, length, issuer_key_hash)
         n = int(data.shape[0])
         data = np.ascontiguousarray(data, np.uint8)
         length = np.ascontiguousarray(length, np.int32)
@@ -210,13 +213,24 @@ def extract_scts(data: np.ndarray, length: np.ndarray,
         i64p = ctypes.POINTER(ctypes.c_int64)
         i32p = ctypes.POINTER(ctypes.c_int32)
         u8p = ctypes.POINTER(ctypes.c_uint8)
+        if issuer_key_hash is None:
+            ikh_ptr = ctypes.cast(None, u8p)
+        else:
+            issuer_key_hash = np.ascontiguousarray(
+                issuer_key_hash, np.uint8)
+            if issuer_key_hash.shape != (n, 32):
+                raise ValueError(
+                    f"issuer_key_hash must be uint8[{n}, 32], got "
+                    f"{issuer_key_hash.shape}")
+            ikh_ptr = issuer_key_hash.ctypes.data_as(u8p)
         t = resolve_threads(n, threads)
-        fn, extra = lib.ctmr_extract_scts, ()
+        fn, extra = lib.ctmr_extract_scts_v2, ()
         if t > 1 and getattr(lib, "has_mt", False):
-            fn, extra = lib.ctmr_extract_scts_mt, (t,)
+            fn, extra = lib.ctmr_extract_scts_v2_mt, (t,)
         fn(
             n, data.ctypes.data_as(u8p), data.shape[1],
             length.ctypes.data_as(i32p),
+            ikh_ptr,
             out.ok.ctypes.data_as(u8p),
             out.digest.ctypes.data_as(u8p),
             out.log_id.ctypes.data_as(u8p),

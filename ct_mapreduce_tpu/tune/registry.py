@@ -37,6 +37,7 @@ SECTIONS = {
     "distrib": ("ct_mapreduce_tpu.distrib", "_DISTRIB_KNOBS"),
     "ckpt": ("ct_mapreduce_tpu.agg.ckpt", "_CKPT_KNOBS"),
     "obs": ("ct_mapreduce_tpu.telemetry.fleetobs", "_OBS_KNOBS"),
+    "audit": ("ct_mapreduce_tpu.audit", "_AUDIT_KNOBS"),
 }
 
 # Declared ladders, coarse-to-fine in the order the search walks them.
@@ -65,6 +66,7 @@ SWEEPABLE = {
     "distrib": {},
     "ckpt": {},
     "obs": {},
+    "audit": {},
 }
 
 # Knobs the search must not touch, each with its justification.
@@ -131,6 +133,12 @@ EXCLUDED = {
                            "for filter consumers, not a measured rate",
         "sloMaxServeP99Ms": "SLO threshold is the latency objective "
                             "being judged — sweeping it is circular",
+    },
+    "audit": {
+        "auditLogList": "trust-anchor list path — identity, never a "
+                        "performance scalar",
+        "auditQuarantineDir": "divergence spool location on the host "
+                              "filesystem, not a perf scalar",
     },
 }
 

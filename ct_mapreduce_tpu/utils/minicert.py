@@ -206,13 +206,17 @@ def make_sct_cert(
     sct_timestamp_ms: int = 1_700_000_000_000,
     sct_extensions: bytes = b"",
     corrupt_signature: bool = False,
+    sct_issuer_der: bytes = b"",
     **kwargs,
 ) -> bytes:
     """A canonical-DER certificate with an embedded, genuinely-signed
     SCT (the round-13 verification fixtures). ``sct_signer`` defaults
     to a deterministic P-256 log key seeded by the issuer CN — same
     dependency-free contract as the rest of this module, so verify
-    tests collect and pass on hosts without ``cryptography``."""
+    tests collect and pass on hosts without ``cryptography``.
+    ``sct_issuer_der``: the issuing certificate whose SPKI hash the
+    SCT signs (RFC 6962 issuer_key_hash); required when the cert will
+    ride a pipeline lane that carries an issuer chain."""
     from ct_mapreduce_tpu.verify import sct as sctlib
 
     der = make_cert(serial=serial, issuer_cn=issuer_cn,
@@ -221,7 +225,7 @@ def make_sct_cert(
         sct_signer = sctlib.EcSctSigner(f"minicert-log:{issuer_cn}")
     return sctlib.attach_sct(
         der, sct_signer, sct_timestamp_ms, extensions=sct_extensions,
-        corrupt_signature=corrupt_signature,
+        corrupt_signature=corrupt_signature, issuer_der=sct_issuer_der,
     )
 
 

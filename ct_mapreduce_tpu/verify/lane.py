@@ -296,7 +296,7 @@ class SignatureVerifier:
                     int(issuer_idx[i]), _table_key(log_id, key),
                 ))
             elif alg == "p384" and not self._stage_p384(
-                    i, log_id, key, issuer_idx, rows, lengths):
+                    i, log_id, key, scts, issuer_idx, rows, lengths):
                 host_lanes.append(i)
             elif alg not in ("p256", "p384"):
                 host_lanes.append(i)
@@ -309,7 +309,7 @@ class SignatureVerifier:
                 self._dispatch(lane, self.batch_width)
         self._drain_inflight(self.depth)
 
-    def _stage_p384(self, i: int, log_id: bytes, key: dict,
+    def _stage_p384(self, i: int, log_id: bytes, key: dict, scts,
                     issuer_idx, rows, lengths) -> bool:
         """Re-extract lane ``i``'s SCT from its row bytes and stage it
         for the P-384 kernel when it is device-decidable: exactly the
@@ -319,7 +319,7 @@ class SignatureVerifier:
         gotten from the host fallback. Returns False (→ host lane,
         which fails it closed) otherwise."""
         der = rows[i, : int(lengths[i])].tobytes()
-        _status, sc, digest, _r, _s = sctlib.extract_sct_lane(der)
+        _status, sc, _digest, _r, _s = sctlib.extract_sct_lane(der)
         if (sc is None or sc.version != 0
                 or sc.hash_alg != sctlib.HASH_SHA256
                 or sc.sig_alg != sctlib.SIG_ECDSA):
@@ -328,7 +328,10 @@ class SignatureVerifier:
         if rs is None:
             return False
         dg = np.zeros((48,), np.uint8)
-        dg[16:] = np.frombuffer(digest, np.uint8)
+        # The batch digest, not the re-extracted one: only the batch
+        # carries the lane's issuer_key_hash (the re-extraction here
+        # is for the signature bytes the compact batch drops).
+        dg[16:] = scts.digest[i]
         self._lane("p384").buf.append((
             dg,
             np.frombuffer(rs[0].to_bytes(48, "big"), np.uint8),
@@ -348,10 +351,14 @@ class SignatureVerifier:
         idx = np.zeros((len(lanes),), np.int64)
         for j, i in enumerate(lanes):
             der = rows[i, : int(lengths[i])].tobytes()
-            _status, sc, digest, _r, _s = sctlib.extract_sct_lane(der)
+            _status, sc, _digest, _r, _s = sctlib.extract_sct_lane(der)
             key = self.keys.get(scts.log_id[i].tobytes())
+            # Judge against the BATCH digest — it carries the lane's
+            # issuer_key_hash; the re-extraction only recovers the
+            # signature bytes the compact batch drops.
             verdicts[j] = (sc is not None and key is not None
-                           and sctlib.host_verify_sct(digest, sc, key))
+                           and sctlib.host_verify_sct(
+                               scts.digest[i].tobytes(), sc, key))
             idx[j] = int(issuer_idx[i])
         self.stats["host_lanes"] += len(lanes)
         incr_counter("verify", "host_lanes", value=float(len(lanes)))

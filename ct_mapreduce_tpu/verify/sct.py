@@ -8,23 +8,26 @@ hash_alg(1) ‖ sig_alg(1) ‖ sig_len(2) ‖ signature. For ECDSA the
 signature bytes are a DER ``ECDSA-Sig-Value`` (SEQUENCE of two
 INTEGERs).
 
-**Signed-payload convention.** RFC 6962 precert SCTs sign a
-reconstructed precert TBS (SCT extension stripped, lengths re-encoded,
-issuer-key-hash prefixed) — a full re-encoder on both the native and
-python extraction paths for a quantity no fixture needs. This
-reproduction pins a byte-splice flavor instead: the signed payload is
+**Signed-payload convention (RFC 6962 §3.2, round 24).** An embedded
+SCT signs the *reconstructed precert TBS*: the TBSCertificate with the
+SCT-list extension (and any poison extension,
+1.3.6.1.4.1.11129.2.4.3) removed and every enclosing DER length
+re-encoded minimally, wrapped as a ``precert_entry``:
 
     version(0x00) ‖ sig_type(0x00) ‖ timestamp(8 BE) ‖
-    entry_type(0x0001) ‖ len3(splice) ‖ splice ‖
-    ext_len(2 BE) ‖ ext_bytes
+    entry_type(0x0001) ‖ issuer_key_hash(32) ‖
+    len3(tbs') ‖ tbs' ‖ ext_len(2 BE) ‖ ext_bytes
 
-where ``splice`` = the certificate DER with the SCT extension's TLV
-**byte-spliced out** (outer length fields untouched). The splice is
-computable in one pass by both extractors and is independent of the
-signature bytes (they live inside the removed TLV), which is what lets
-:func:`attach_sct` sign-then-patch. Real-log SCTs would need the RFC
-reconstruction and real log keys — neither exists in this
-reproduction's test universe; ARCHITECTURE.md records the limit.
+where ``tbs'`` = :func:`reconstruct_precert_tbs` and
+``issuer_key_hash`` = SHA-256 of the issuing certificate's SPKI DER
+(:func:`issuer_key_hash_of`; all-zero when the lane carries no issuer
+chain — such lanes can never verify against a real log key, matching
+RFC semantics). The digest is still independent of the signature bytes
+(they live inside the removed extension), which is what lets
+:func:`attach_sct` sign-then-patch. This REPLACES the pre-round-24
+byte-splice convention (PR 8's documented limit): real embedded SCTs
+from production logs now verify against production log keys
+(``audit/loglist.py``).
 
 ``extract_scts_np`` is the pure-python mirror of the native
 ``ctmr_extract_scts`` pass (ctmr_native.cpp) — bit-identical outputs,
@@ -43,6 +46,13 @@ from ct_mapreduce_tpu.verify import host
 
 # OID 1.3.6.1.4.1.11129.2.4.2 content bytes.
 SCT_OID = bytes.fromhex("2b06010401d679020402")
+# OID 1.3.6.1.4.1.11129.2.4.3 — the precert poison extension
+# (RFC 6962 §3.1); stripped alongside the SCT list during TBS
+# reconstruction so a precert and its final cert sign identically.
+POISON_OID = bytes.fromhex("2b06010401d679020403")
+
+# issuer_key_hash for lanes with no issuer chain.
+ZERO_IKH = bytes(32)
 
 # Lane status codes (keep in sync with ctmr_native.cpp).
 SCT_NONE = 0  # no (parseable) SCT extension on the lane
@@ -142,6 +152,122 @@ def find_sct_extension(der: bytes):
     return None
 
 
+def find_spki(der: bytes):
+    """Locate the subjectPublicKeyInfo TLV: (tlv_off, tlv_end) of the
+    full SPKI SEQUENCE (header included), or None. Same acceptance as
+    :func:`find_sct_extension`'s walk — SPKI is the sixth field after
+    the optional [0] version."""
+    n = len(der)
+    t = _tlv(der, 0, n)
+    if t is None or t[0] != 0x30:
+        return None
+    t = _tlv(der, t[1], t[1] + t[2])
+    if t is None or t[0] != 0x30:
+        return None
+    _, tbs_off, tbs_len = t
+    end = tbs_off + tbs_len
+    off = tbs_off
+    t = _tlv(der, off, end)
+    if t is None:
+        return None
+    if t[0] == 0xA0:  # explicit [0] version
+        off = t[1] + t[2]
+    for _ in range(5):  # serial, sigalg, issuer, validity, subject
+        t = _tlv(der, off, end)
+        if t is None:
+            return None
+        off = t[1] + t[2]
+    t = _tlv(der, off, end)
+    if t is None or t[0] != 0x30:
+        return None
+    return off, t[1] + t[2]
+
+
+def issuer_key_hash_of(issuer_der: bytes) -> bytes:
+    """RFC 6962 issuer_key_hash: SHA-256 over the issuing cert's SPKI
+    DER (header included). All-zero when the issuer doesn't parse —
+    the lane then carries a hash no real log signed, so it fails
+    verification instead of silently passing."""
+    win = find_spki(issuer_der)
+    if win is None:
+        return ZERO_IKH
+    return hashlib.sha256(issuer_der[win[0]:win[1]]).digest()
+
+
+def reconstruct_precert_tbs(der: bytes):
+    """RFC 6962 §3.2 TBS reconstruction: the certificate's
+    TBSCertificate with every SCT-list and poison extension removed
+    and the enclosing lengths ([3] → extensions SEQUENCE → TBS)
+    re-encoded minimally. When stripping empties the extensions list,
+    the [3] element is omitted entirely. Returns the re-encoded TBS
+    bytes (header included), or None when the certificate doesn't
+    parse to the extractor's acceptance.
+
+    The native scanner (ctmr_native.cpp ``sctext::digest_lane``)
+    streams exactly these bytes into SHA-256 without materializing the
+    buffer; parity is pinned by the KAT + mutation fuzz in
+    tests/test_audit.py."""
+    n = len(der)
+    t = _tlv(der, 0, n)
+    if t is None or t[0] != 0x30:
+        return None
+    t = _tlv(der, t[1], t[1] + t[2])
+    if t is None or t[0] != 0x30:
+        return None
+    _, tbs_off, tbs_len = t
+    tbs_end = tbs_off + tbs_len
+    off = tbs_off
+    t = _tlv(der, off, tbs_end)
+    if t is None:
+        return None
+    if t[0] == 0xA0:
+        off = t[1] + t[2]
+    for _ in range(6):  # serial, sigalg, issuer, validity, subj, SPKI
+        t = _tlv(der, off, tbs_end)
+        if t is None:
+            return None
+        off = t[1] + t[2]
+    # Trailing elements: [1]/[2] unique IDs pass through; [3] is the
+    # extensions element to rebuild.
+    a3_off = None
+    while off < tbs_end:
+        t = _tlv(der, off, tbs_end)
+        if t is None:
+            return None
+        if t[0] == 0xA3:
+            a3_off = off
+            a3_end = t[1] + t[2]
+            seq = _tlv(der, t[1], a3_end)
+            if seq is None or seq[0] != 0x30:
+                return None
+            seq_off, seq_len = seq[1], seq[2]
+            break
+        off = t[1] + t[2]
+    if a3_off is None:
+        # No extensions: the reconstruction is the TBS content as-is
+        # (re-wrapped so a non-minimal original length normalizes).
+        return _wrap_tlv(0x30, der[tbs_off:tbs_end])
+    kept = bytearray()
+    p, p_end = seq_off, seq_off + seq_len
+    while p < p_end:
+        ext = _tlv(der, p, p_end)
+        if ext is None or ext[0] != 0x30:
+            return None
+        ext_end = ext[1] + ext[2]
+        oid = _tlv(der, ext[1], ext_end)
+        if oid is None or oid[0] != 0x06:
+            return None
+        o = der[oid[1]:oid[1] + oid[2]]
+        if o != SCT_OID and o != POISON_OID:
+            kept += der[p:ext_end]
+        p = ext_end
+    new_exts = b""
+    if kept:
+        new_exts = _wrap_tlv(0xA3, _wrap_tlv(0x30, bytes(kept)))
+    content = der[tbs_off:a3_off] + new_exts + der[a3_end:tbs_end]
+    return _wrap_tlv(0x30, content)
+
+
 @dataclass
 class ParsedSct:
     """First SCT of a lane's list, as far as the wire parse got."""
@@ -215,15 +341,25 @@ def parse_ecdsa_sig(sig: bytes, max_bytes: int = 32):
 
 
 def sct_digest(der: bytes, tlv_off: int, tlv_end: int,
-               timestamp_ms: int, extensions: bytes = b"") -> bytes:
-    """The convention's SHA-256 signing digest for one lane."""
-    splice_len = len(der) - (tlv_end - tlv_off)
+               timestamp_ms: int, extensions: bytes = b"",
+               issuer_key_hash: bytes = ZERO_IKH):
+    """The RFC 6962 §3.2 SHA-256 signing digest for one lane's
+    embedded SCT (precert_entry over the reconstructed TBS), or None
+    when the certificate doesn't reconstruct. ``tlv_off``/``tlv_end``
+    are accepted for signature continuity with the pre-round-24
+    convention (the reconstruction re-finds and strips every SCT/
+    poison extension itself)."""
+    del tlv_off, tlv_end
+    tbs = reconstruct_precert_tbs(der)
+    if tbs is None:
+        return None
     payload = (
         b"\x00\x00"
         + timestamp_ms.to_bytes(8, "big")
         + b"\x00\x01"
-        + splice_len.to_bytes(3, "big")
-        + der[:tlv_off] + der[tlv_end:]
+        + issuer_key_hash
+        + len(tbs).to_bytes(3, "big")
+        + tbs
         + len(extensions).to_bytes(2, "big")
         + extensions
     )
@@ -259,7 +395,7 @@ class SctBatch:
         )
 
 
-def extract_sct_lane(der: bytes):
+def extract_sct_lane(der: bytes, issuer_key_hash: bytes = ZERO_IKH):
     """One lane: (status, ParsedSct | None, digest | None, r, s).
 
     The native scanner implements exactly this classification; keep
@@ -272,7 +408,9 @@ def extract_sct_lane(der: bytes):
     if sct is None:
         return SCT_NONE, None, None, 0, 0
     digest = sct_digest(der, tlv_off, tlv_end, sct.timestamp_ms,
-                        sct.extensions)
+                        sct.extensions, issuer_key_hash)
+    if digest is None:  # pragma: no cover - find succeeded, so walk does
+        return SCT_NONE, None, None, 0, 0
     if (sct.version != 0 or sct.hash_alg != HASH_SHA256
             or sct.sig_alg != SIG_ECDSA):
         return SCT_FALLBACK, sct, digest, 0, 0
@@ -282,10 +420,12 @@ def extract_sct_lane(der: bytes):
     return SCT_OK, sct, digest, rs[0], rs[1]
 
 
-def extract_scts_np(data: np.ndarray, length: np.ndarray) -> SctBatch:
+def extract_scts_np(data: np.ndarray, length: np.ndarray,
+                    issuer_key_hash=None) -> SctBatch:
     """Python extraction over packed rows uint8[n, pad] + int32[n]
     lengths — the no-native fallback (and the native pass's parity
-    reference)."""
+    reference). ``issuer_key_hash``: uint8[n, 32] per-lane issuer key
+    hashes (None → all-zero: no issuer chain)."""
     n = int(data.shape[0])
     out = SctBatch.empty(n)
     for i in range(n):
@@ -293,7 +433,9 @@ def extract_scts_np(data: np.ndarray, length: np.ndarray) -> SctBatch:
         if ln <= 0:
             continue
         der = data[i, :ln].tobytes()
-        status, sct, digest, r, s = extract_sct_lane(der)
+        ikh = (ZERO_IKH if issuer_key_hash is None
+               else bytes(issuer_key_hash[i]))
+        status, sct, digest, r, s = extract_sct_lane(der, ikh)
         out.ok[i] = status
         if sct is None:
             continue
@@ -428,16 +570,24 @@ def build_sct_list(log_id: bytes, timestamp_ms: int, hash_alg: int,
 
 def attach_sct(der: bytes, signer, timestamp_ms: int,
                extensions: bytes = b"",
-               corrupt_signature: bool = False) -> bytes:
+               corrupt_signature: bool = False,
+               issuer_key_hash: bytes = ZERO_IKH,
+               issuer_der: bytes = b"") -> bytes:
     """Embed a signed SCT into an existing certificate by DER surgery.
 
     The SCT extension is appended as the LAST extension (creating the
     [3] list if absent), with a zeroed fixed-length signature; the
-    convention digest is computed over the resulting splice (which
+    RFC 6962 digest is computed over the reconstructed TBS (which
     excludes the whole extension, hence the signature), the signer
     signs it, and the signature bytes are patched in place.
     ``corrupt_signature`` flips a bit post-signing (failing fixture).
+    The signed issuer_key_hash comes from ``issuer_der`` (the issuing
+    cert, hashed via :func:`issuer_key_hash_of`) or raw
+    ``issuer_key_hash``; default all-zero matches lanes ingested
+    without an issuer chain.
     """
+    if issuer_der:
+        issuer_key_hash = issuer_key_hash_of(issuer_der)
     n = len(der)
     t = _tlv(der, 0, n)
     if t is None or t[0] != 0x30:
@@ -496,7 +646,9 @@ def attach_sct(der: bytes, signer, timestamp_ms: int,
         raise RuntimeError("embedded SCT extension not found back")
     tlv_off, tlv_end, v_off, _v_end = win
     digest = sct_digest(new_cert, tlv_off, tlv_end, timestamp_ms,
-                        extensions)
+                        extensions, issuer_key_hash)
+    if digest is None:
+        raise RuntimeError("TBS reconstruction failed on own output")
     sig = bytearray(signer.sign(digest))
     if len(sig) != signer.sig_len:
         raise RuntimeError("signer broke its fixed-length contract")

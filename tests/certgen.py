@@ -185,12 +185,15 @@ def make_sct_cert(
     sct_timestamp_ms: int = 1_700_000_000_000,
     sct_extensions: bytes = b"",
     corrupt_signature: bool = False,
+    issuer_der: bytes = b"",
     **kwargs,
 ) -> bytes:
     """An SCT-embedded fixture cert: :func:`make_cert` (cryptography
     when present, minicert otherwise — identical degradation contract)
     plus DER surgery embedding a genuinely-signed SCT
-    (:func:`ct_mapreduce_tpu.verify.sct.attach_sct`)."""
+    (:func:`ct_mapreduce_tpu.verify.sct.attach_sct`). ``issuer_der``
+    feeds the RFC 6962 issuer_key_hash; pass the chain issuer when the
+    cert rides a pipeline lane with one."""
     from ct_mapreduce_tpu.verify import sct as sctlib
 
     der = make_cert(**kwargs)
@@ -198,7 +201,7 @@ def make_sct_cert(
         signer = sct_signer()
     return sctlib.attach_sct(
         der, signer, sct_timestamp_ms, extensions=sct_extensions,
-        corrupt_signature=corrupt_signature,
+        corrupt_signature=corrupt_signature, issuer_der=issuer_der,
     )
 
 

@@ -23,6 +23,8 @@ class FakeLog:
         self.entries: list[dict] = []  # {"leaf_input": b64, "extra_data": b64}
         self.max_batch = 1000
         self.rate_limit_hits = 0  # serve this many 429s before succeeding
+        self.server_error_hits = 0  # serve this many 5xx before succeeding
+        self.server_error_status = 503
         self.retry_after: str | None = None
         self.requests: list[str] = []
 
@@ -73,6 +75,12 @@ class FakeLog:
             if self.retry_after is not None:
                 headers["Retry-After"] = self.retry_after
             return 429, headers, b"slow down"
+        if self.server_error_hits > 0:
+            self.server_error_hits -= 1
+            headers = {}
+            if self.retry_after is not None:
+                headers["Retry-After"] = self.retry_after
+            return self.server_error_status, headers, b"upstream sad"
         parsed = urlparse(url)
         if parsed.path.endswith("/ct/v1/get-sth"):
             return 200, {}, json.dumps(

@@ -341,6 +341,43 @@ def test_bench_smoke_verify_gate():
     assert out["smoke_verify_qtable_hits"] > 0
 
 
+@pytest.mark.timeout(240)
+def test_bench_smoke_audit_gate():
+    """Audit leg (round 24): run_audit_smoke itself gates every
+    recorded-shard tally against the fixture's ground truth × tile,
+    the per-issuer folds against a host-recomputed reference-verifier
+    oracle, and quarantined == 0 PINNED on the real corpus; here we
+    pin that the leg ran at tier-1 scale (>= 10^5 entries through the
+    full decode+verify+aggregate path) with real work in every lane
+    class and that divergence was actually measured."""
+    import jax
+
+    if os.environ.get("CT_TPU_TESTS", "") == "":
+        jax.config.update("jax_platforms", "cpu")
+    import bench
+
+    out = bench.run_audit_smoke()  # raises BenchError on any miss
+    assert out["metric"] == "ct_audit_smoke"
+    assert out["value"] > 0
+    assert out["smoke_audit_entries"] >= 100_000
+    assert out["smoke_audit_quarantined"] == 0
+    assert out["smoke_audit_verified"] > 0
+    assert out["smoke_audit_failed"] > 0
+    assert out["smoke_audit_no_key"] > 0
+    assert out["smoke_audit_retired"] > 0
+    assert out["smoke_audit_out_of_interval"] > 0
+    assert out["smoke_audit_device_lanes"] > 0
+    assert out["smoke_audit_host_lanes"] > 0
+    assert out["smoke_audit_per_issuer_groups"] == 8
+    # The quarantine pin is only meaningful when the native scanner
+    # actually ran against the mirror.
+    from ct_mapreduce_tpu.native import load as load_native
+
+    if (os.environ.get("CTMR_NATIVE", "1") != "0"
+            and getattr(load_native(), "has_sct", False)):
+        assert out["smoke_audit_divergence_measured"] == 1
+
+
 @pytest.mark.timeout(300)
 def test_bench_smoke_tune_gate(monkeypatch):
     """Autotune leg (round 21): run_tune_smoke itself gates a REAL

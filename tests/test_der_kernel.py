@@ -462,6 +462,55 @@ def test_divergence_trend_floor_gate():
         doc2 = divergence.record_trend(report, fresh)
         assert (doc2["floorDeviceAcceptRate"]
                 == round(report.device_accept_rate, 6))
+        # A real-corpus run pins its OWN floor, not the fuzz one.
+        doc3 = divergence.record_trend(report, fresh, corpus="real")
+        assert (doc3["floorRealAcceptRate"]
+                == round(report.device_accept_rate, 6))
+        assert doc3["runs"][-1]["corpus"] == "real"
+        assert divergence.trend_floor(fresh, corpus="real") == (
+            doc3["floorRealAcceptRate"])
+
+
+def test_divergence_real_corpus_floor_gate():
+    """Round 24: the recorded-shard corpus (tests/data/
+    recorded_shard.json.gz — real-wire get-entries pages through the
+    production leaf codec) classified through the same differential
+    harness. A real shard must be accepted essentially wholesale:
+    the rate is graded against `floorRealAcceptRate` (pinned by the
+    checked-in first run, a separate ratchet from the fuzz floor,
+    which grades corpora BUILT to be mostly rejected), and the hard
+    buckets stay empty — both parsers agreeing to accept a real cert
+    while extracting different identity fields would poison
+    aggregates silently."""
+    import os
+
+    from ct_mapreduce_tpu.audit import fixture as auditfx
+    from ct_mapreduce_tpu.core import divergence
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    floor = divergence.trend_floor(
+        os.path.join(root, "DIVERGENCE_TREND.json"), corpus="real")
+    assert floor is not None and 0 < floor <= 1, floor
+
+    from ct_mapreduce_tpu.audit import driver as drvlib
+
+    doc = drvlib.load_recorded(
+        os.path.join(root, "tests", "data", "recorded_shard.json.gz"))
+    ders = auditfx.shard_ders(doc)
+    assert len(ders) >= 1000, len(ders)
+    # The shard's DERs all fit the default 1024 pad — same compiled
+    # walker shape every other gate in this file uses.
+    report = divergence.classify_corpus(ders)
+    assert report.device_accept_rate >= floor, (
+        f"real-corpus accept rate {report.device_accept_rate:.4f} "
+        f"dropped below the recorded floor {floor} "
+        "(DIVERGENCE_TREND.json); a deliberate strictness change "
+        "must re-baseline the floor explicitly")
+    assert report.verdict_mismatch == 0, report.details
+    from ct_mapreduce_tpu.native import available
+
+    if available():
+        assert report.sidecar_undecidable == 0, report.sidecar_undecidable
 
 
 def test_grammar_mutation_fuzz_buckets():

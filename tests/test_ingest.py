@@ -127,6 +127,63 @@ def test_client_429_backoff_and_retry_after():
     assert len(sleeps) == 1 and 0 < sleeps[0] <= 300.0  # jittered window
 
 
+def test_client_5xx_backoff_same_lane():
+    # Transient 5xx takes the exact 429 lane: backoff + jitter +
+    # Retry-After clamp, then the same range retried.
+    log = FakeLog()
+    leaf, issuer = _leaf_and_issuer(2)
+    log.add_cert(leaf, issuer)
+    log.server_error_hits = 2
+    log.server_error_status = 503
+    log.retry_after = "7"
+    sleeps = []
+    c = CTLogClient(log.url, transport=log.transport, sleep=sleeps.append)
+    sth = c.get_sth()
+    assert sth.tree_size == 1
+    assert sleeps == [7.0, 7.0]  # Retry-After honored on 5xx too
+
+    log.server_error_hits = 1
+    log.server_error_status = 502
+    log.retry_after = None
+    sleeps.clear()
+    c.get_sth()
+    assert len(sleeps) == 1 and 0 < sleeps[0] <= 300.0
+
+
+def test_client_non_retryable_status_still_raises():
+    from ct_mapreduce_tpu.ingest.ctclient import CTClientError
+
+    log = FakeLog()
+    leaf, issuer = _leaf_and_issuer(2)
+    log.add_cert(leaf, issuer)
+    sleeps = []
+    c = CTLogClient(log.url, transport=log.transport, sleep=sleeps.append)
+    with pytest.raises(CTClientError):
+        c.get_raw_entries(5, 9)  # beyond tree size → 400: no retry
+    assert sleeps == []
+
+
+def test_client_window_clamps_to_served_page():
+    log = FakeLog()
+    leaf, issuer = _leaf_and_issuer(4)
+    for s in range(10):
+        log.add_cert(leaf, issuer, timestamp_ms=s)
+    log.max_batch = 3  # the server's real cap, discovered on the wire
+    c = CTLogClient(log.url, transport=log.transport)
+    got = c.get_raw_entries(0, 9)
+    assert [e.index for e in got] == [0, 1, 2]
+    assert c.page_size == 3
+    # The next window is pre-clamped: the wire shows end = start + 2.
+    got = c.get_raw_entries(3, 9)
+    assert [e.index for e in got] == [3, 4, 5]
+    assert log.requests[-1].endswith("start=3&end=5")
+    # A tail page shorter than the clamp (tree ends) must not shrink
+    # the window further: 9..9 is a full answer for the asked range.
+    got = c.get_raw_entries(9, 9)
+    assert [e.index for e in got] == [9]
+    assert c.page_size == 3
+
+
 # -- LogWorker resume window ------------------------------------------------
 
 

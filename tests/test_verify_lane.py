@@ -57,31 +57,36 @@ def _corpus(n=24):
             is_ca=False, not_after=FUTURE)
         kind = s % 6
         if kind == 0:
-            der = sctlib.attach_sct(base, p256, 10**12 + s)
+            der = sctlib.attach_sct(base, p256, 10**12 + s,
+                                    issuer_der=issuer)
             expect["verified"] += 1
             expect["device"] += 1
         elif kind == 1:
             der = sctlib.attach_sct(base, p256, 10**12 + s,
-                                    corrupt_signature=True)
+                                    corrupt_signature=True,
+                                    issuer_der=issuer)
             expect["failed"] += 1
             expect["device"] += 1
         elif kind == 2:
             # P-384 lanes ride the DEVICE since round 17 (re-extracted
             # from row bytes, verified by the windowed P-384 kernel).
-            der = sctlib.attach_sct(base, p384, 10**12 + s)
+            der = sctlib.attach_sct(base, p384, 10**12 + s,
+                                    issuer_der=issuer)
             expect["verified"] += 1
             expect["device"] += 1
             expect["p384"] += 1
         elif kind == 3:
             der = sctlib.attach_sct(base, rsa, 10**12 + s,
-                                    corrupt_signature=True)
+                                    corrupt_signature=True,
+                                    issuer_der=issuer)
             expect["failed"] += 1
             expect["host"] += 1
         elif kind == 4:
             der = base
             expect["no_sct"] += 1
         else:
-            der = sctlib.attach_sct(base, unknown, 10**12 + s)
+            der = sctlib.attach_sct(base, unknown, 10**12 + s,
+                                    issuer_der=issuer)
             expect["no_key"] += 1
         pairs.append((der, issuer))
     return pairs, expect
